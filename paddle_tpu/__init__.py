@@ -1,0 +1,97 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Brand-new design with the capability surface of the PaddlePaddle reference
+(/root/reference), built on JAX/XLA/Pallas:
+
+- Tensors wrap jax.Array; XLA owns kernels, layouts, memory (replacing the phi
+  kernel registry / allocator stack).
+- Eager autograd is a VJP tape (framework/core.py); functional/jit training
+  uses jax.grad through paddle_tpu.jit.
+- Distributed = named mesh axes + compiled ICI/DCN collectives (paddle_tpu.distributed).
+"""
+
+from __future__ import annotations
+
+from . import autograd, framework, tensor
+from .autograd import PyLayer, enable_grad, grad, no_grad, set_grad_enabled
+from .framework import (
+    Parameter,
+    Tensor,
+    get_default_dtype,
+    get_flags,
+    load,
+    save,
+    seed,
+    set_default_dtype,
+    set_flags,
+    to_tensor,
+)
+from .framework.core import is_grad_enabled
+from .framework.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .framework.random import get_rng_state, set_rng_state
+from .tensor import *  # noqa: F401,F403
+from .tensor import linalg  # namespace: paddle.linalg.*
+from .tensor.logic import is_tensor
+
+__version__ = "0.1.0"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(name: str) -> bool:
+    return name in ("tpu",)
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def set_device(device: str):
+    # single-controller JAX owns placement; accepted for API parity
+    return device
+
+
+def get_device() -> str:
+    import jax
+
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+class CPUPlace:
+    pass
+
+
+class TPUPlace:
+    def __init__(self, idx: int = 0):
+        self.idx = idx
+
+
+CUDAPlace = TPUPlace  # scripts that name CUDAPlace get the accelerator
+
+# subpackages added as they are built (M2+)

@@ -1,0 +1,283 @@
+"""Autograd user API (reference: python/paddle/autograd/).
+
+backward / PyLayer / functional vjp-jvp-jacobian-hessian, re-expressed on the
+tape in framework/core.py (the reference's C++ engine: paddle/fluid/eager/).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import (
+    GradNode,
+    Tensor,
+    no_grad,
+    enable_grad,
+    is_grad_enabled,
+    set_grad_enabled,
+    run_op,
+    to_tensor,
+)
+from ..framework.core import backward as _backward_impl
+
+__all__ = [
+    "backward",
+    "grad",
+    "PyLayer",
+    "PyLayerContext",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "vjp",
+    "jvp",
+    "jacobian",
+    "hessian",
+]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference: python/paddle/autograd/autograd.py)."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        _backward_impl(t, g, retain_graph=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad: returns grads of outputs wrt inputs without touching .grad
+    (reference: python/paddle/base/dygraph/base.py:grad)."""
+    single_out = isinstance(outputs, Tensor)
+    outs = [outputs] if single_out else list(outputs)
+    single_in = isinstance(inputs, Tensor)
+    ins = [inputs] if single_in else list(inputs)
+    saved = [(t.grad, t.stop_gradient, t._retain_grads) for t in ins]
+    for t in ins:
+        t.grad = None
+        t.stop_gradient = False
+        t._retain_grads = True  # deliver grads to intermediates too
+    gouts = grad_outputs
+    if gouts is None:
+        gouts = [None] * len(outs)
+    elif isinstance(gouts, Tensor):
+        gouts = [gouts]
+    try:
+        for o, g in zip(outs, gouts):
+            _backward_impl(o, g, retain_graph=True)
+        results = []
+        for t in ins:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears unused; "
+                        "pass allow_unused=True to return None for it"
+                    )
+                results.append(None)
+            else:
+                results.append(t.grad)
+    finally:
+        for t, (g, sg, rg) in zip(ins, saved):
+            t.grad = g
+            t.stop_gradient = sg
+            t._retain_grads = rg
+    return results[0] if single_in else results
+
+
+# --------------------------------------------------------------------------- #
+# PyLayer
+# --------------------------------------------------------------------------- #
+
+
+class PyLayerContext:
+    """ctx passed to PyLayer.forward/backward
+    (reference: python/paddle/autograd/py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable op (reference: python/paddle/autograd/py_layer.py:PyLayer).
+
+    The recompute / sequence-parallel / MoE-dispatch machinery all build on this,
+    exactly as in the reference.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        tensor_inputs = []
+        for a in args:
+            if isinstance(a, Tensor):
+                tensor_inputs.append(a)
+        for v in kwargs.values():
+            if isinstance(v, Tensor):
+                tensor_inputs.append(v)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        out_tensors = [o for o in outs if isinstance(o, Tensor)]
+
+        need_grad = is_grad_enabled() and any(
+            (not t.stop_gradient) or t._grad_node is not None for t in tensor_inputs
+        )
+        if not need_grad:
+            return outputs if multi else outs[0]
+
+        avals = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype) for o in out_tensors]
+
+        def vjp_fn(cots):
+            if not isinstance(cots, tuple):
+                cots = (cots,)
+            cot_tensors = [Tensor(c) for c in cots]
+            with no_grad():
+                gin = cls.backward(ctx, *cot_tensors)
+            if not isinstance(gin, (tuple, list)):
+                gin = (gin,)
+            raw = []
+            for g in gin:
+                if g is None:
+                    raw.append(None)
+                elif isinstance(g, Tensor):
+                    raw.append(g._value)
+                else:
+                    raw.append(jnp.asarray(g))
+            # align with tensor_inputs
+            raw = [r for r in raw]
+            if len(raw) < len(tensor_inputs):
+                raw += [None] * (len(tensor_inputs) - len(raw))
+            return tuple(raw[: len(tensor_inputs)])
+
+        node = GradNode(cls.__name__, vjp_fn, tensor_inputs, avals)
+        new_outs = []
+        node_outs = []
+        ti = 0
+        for o in outs:
+            if isinstance(o, Tensor):
+                t = Tensor(o._value, stop_gradient=False)
+                t._grad_node = node
+                t._out_index = ti
+                ti += 1
+                new_outs.append(t)
+                node_outs.append(t)
+            else:
+                new_outs.append(o)
+        node.set_outputs(node_outs)
+        if multi:
+            return type(outputs)(new_outs) if isinstance(outputs, tuple) else new_outs
+        return new_outs[0]
+
+
+# --------------------------------------------------------------------------- #
+# functional AD (reference: python/paddle/autograd/autograd.py jacobian/hessian,
+# python/paddle/incubate/autograd/functional.py vjp/jvp)
+# --------------------------------------------------------------------------- #
+
+
+def _wrap_fn(func):
+    def raw(*vals):
+        ts = [Tensor(v, stop_gradient=False) for v in vals]
+        out = func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    return raw
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    raw = _wrap_fn(func)
+    out, f_vjp = jax.vjp(raw, *[x._value for x in xs_l])
+    if v is None:
+        seed = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(jnp.ones_like(o) for o in out)
+    else:
+        if isinstance(v, Tensor):
+            seed = v._value
+        elif isinstance(v, (tuple, list)):
+            seed = tuple(t._value for t in v)
+        else:
+            seed = v
+    grads = f_vjp(seed)
+    outs = Tensor(out) if not isinstance(out, tuple) else tuple(Tensor(o) for o in out)
+    gts = [Tensor(g) for g in grads]
+    return outs, (gts[0] if single else gts)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    raw = _wrap_fn(func)
+    primals = tuple(x._value for x in xs_l)
+    if v is None:
+        tangents = tuple(jnp.ones_like(p) for p in primals)
+    elif isinstance(v, Tensor):
+        tangents = (v._value,)
+    else:
+        tangents = tuple(t._value for t in v)
+    out, tang = jax.jvp(raw, primals, tangents)
+    outs = Tensor(out) if not isinstance(out, tuple) else tuple(Tensor(o) for o in out)
+    tangs = Tensor(tang) if not isinstance(tang, tuple) else tuple(Tensor(t) for t in tang)
+    return outs, tangs
+
+
+def jacobian(func, xs, batch_axis=None):
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    raw = _wrap_fn(func)
+    jac = jax.jacobian(raw, argnums=tuple(range(len(xs_l))))(*[x._value for x in xs_l])
+    if single:
+        j = jac[0] if isinstance(jac, tuple) else jac
+        return Tensor(j)
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, xs, batch_axis=None):
+    single = isinstance(xs, Tensor)
+    xs_l = [xs] if single else list(xs)
+    raw = _wrap_fn(func)
+    hes = jax.hessian(raw, argnums=tuple(range(len(xs_l))))(*[x._value for x in xs_l])
+    if single:
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return Tensor(h)
+    return tuple(tuple(Tensor(c) for c in row) for row in hes)

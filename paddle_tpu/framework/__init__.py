@@ -1,0 +1,35 @@
+"""Framework core: Tensor, dtype, RNG, flags, save/load."""
+
+from . import dtype
+from .core import (
+    Parameter,
+    Tensor,
+    enable_grad,
+    in_tracing,
+    is_grad_enabled,
+    no_grad,
+    register_tensor_method,
+    run_op,
+    set_grad_enabled,
+    to_tensor,
+    tracing_guard,
+)
+from .dtype import get_default_dtype, set_default_dtype
+from .random import get_rng_state, seed, set_rng_state
+from .flags import get_flags, set_flags
+from .io import load, save
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "to_tensor",
+    "no_grad",
+    "enable_grad",
+    "seed",
+    "save",
+    "load",
+    "get_default_dtype",
+    "set_default_dtype",
+    "get_flags",
+    "set_flags",
+]

@@ -1,0 +1,649 @@
+"""Core Tensor type and the eager autograd tape.
+
+TPU-native redesign of the reference's eager stack:
+
+- The reference pairs a C++ DenseTensor (paddle/phi/core/dense_tensor.h:37) with
+  per-tensor AutogradMeta (paddle/fluid/eager/autograd_meta.h:61) and hand-written
+  / generated GradNode classes wired per op (paddle/fluid/eager/grad_node_info.h:197,
+  eager_gen.py). Here a `Tensor` wraps a `jax.Array` (XLA owns memory, layout and
+  dtype dispatch — the phi KernelFactory has no TPU analog to build), and the grad
+  graph is obtained *for free* per-op from `jax.vjp`: every op executed through
+  `run_op` records a Wengert-list `GradNode` holding the op's VJP closure.
+- `backward()` (reference: paddle/fluid/eager/backward.cc:105 RunBackward) walks
+  nodes in reverse creation order — creation ids give a valid topological order of
+  the DAG, so no in-degree map is needed.
+- Gradient hooks fire exactly like the reference's (reducer / sequence-parallel
+  allreduce hooks attach here).
+
+Under `jax.jit` tracing (to_static / functional training step) tensors wrap
+tracers; tape recording is disabled and differentiation happens through jax.grad
+on the functional path instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "to_tensor",
+    "run_op",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "in_tracing",
+    "tracing_guard",
+    "register_tensor_method",
+]
+
+# --------------------------------------------------------------------------- #
+# global modes
+# --------------------------------------------------------------------------- #
+
+_mode = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_mode, "grad_enabled", True)
+
+
+def set_grad_enabled(flag: bool):
+    _mode.grad_enabled = bool(flag)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad equivalent (reference: python/paddle/base/dygraph/base.py)."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def in_tracing() -> bool:
+    """True while executing inside a jax trace (to_static / functional path)."""
+    return getattr(_mode, "tracing", False)
+
+
+@contextlib.contextmanager
+def tracing_guard(flag: bool = True):
+    prev = in_tracing()
+    _mode.tracing = flag
+    try:
+        yield
+    finally:
+        _mode.tracing = prev
+
+
+# Interceptor hook point (used by amp autocast, analog of the AMP branch in
+# generated ad_func entry points — reference:
+# paddle/fluid/eager/api/manual/eager_manual/forwards/multiply_fwd_func.cc:49-70).
+# Signature: fn(op_name, values) -> values
+_op_input_interceptor: Callable | None = None
+
+
+def set_op_input_interceptor(fn):
+    global _op_input_interceptor
+    _op_input_interceptor = fn
+
+
+# --------------------------------------------------------------------------- #
+# autograd tape
+# --------------------------------------------------------------------------- #
+
+_node_counter = itertools.count()
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    Holds the VJP closure from jax.vjp plus edges to the input tensors
+    (the closure's residuals play the role of the reference's TensorWrapper,
+    paddle/fluid/eager/tensor_wrapper.h:39).
+    """
+
+    __slots__ = ("id", "name", "vjp_fn", "inputs", "out_avals", "weak_outputs")
+
+    def __init__(self, name, vjp_fn, inputs, out_avals):
+        self.id = next(_node_counter)
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[Tensor]
+        self.out_avals = out_avals  # list[jax.ShapeDtypeStruct]
+        self.weak_outputs = []  # list[weakref.ref[Tensor]], set by run_op
+
+    def set_outputs(self, tensors):
+        import weakref
+
+        self.weak_outputs = [weakref.ref(t) for t in tensors]
+
+    def __repr__(self):
+        return f"<GradNode {self.name} id={self.id}>"
+
+
+def _is_float0(g):
+    return getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+def backward(tensor: "Tensor", grad_tensor: "Tensor" = None, retain_graph: bool = False):
+    """Reverse-mode execution of the tape from `tensor`.
+
+    Reference: egr::Backward / RunBackward (paddle/fluid/eager/backward.cc:441,105).
+    Node ids are monotonically increasing in creation order, so visiting reachable
+    nodes in decreasing id order is a valid reverse-topological schedule.
+    """
+    root = tensor._grad_node
+    if root is None:
+        # leaf: backward on a leaf just seeds its own grad
+        if not tensor.stop_gradient:
+            seed = grad_tensor._value if grad_tensor is not None else jnp.ones_like(tensor._value)
+            tensor._accumulate_grad(seed)
+        return
+
+    if grad_tensor is None:
+        if tensor._value.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad_tensor"
+            )
+        seed = jnp.ones_like(tensor._value)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # collect reachable nodes
+    reachable: dict[int, GradNode] = {}
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if node.id in reachable:
+            continue
+        reachable[node.id] = node
+        for t in node.inputs:
+            if t._grad_node is not None and t._grad_node.id not in reachable:
+                stack.append(t._grad_node)
+
+    # cotangent buffers: node.id -> list per output slot
+    cots: dict[int, list] = {root.id: [None] * len(root.out_avals)}
+    idx = tensor._out_index
+    cots[root.id][idx] = seed
+
+    for nid in sorted(reachable.keys(), reverse=True):
+        node = reachable[nid]
+        out_cots = cots.get(nid)
+        if out_cots is None:
+            continue  # not on any path from the root
+        full = [
+            c if c is not None else jnp.zeros(av.shape, av.dtype)
+            for c, av in zip(out_cots, node.out_avals)
+        ]
+        # Hooks fire on (and may modify) the full accumulated grad of each
+        # output tensor; retain_grads() captures it into .grad — this is
+        # where the reference's intermediate-tensor hooks live
+        # (paddle/fluid/eager/backward.cc hook dispatch).
+        for i, wref in enumerate(node.weak_outputs):
+            t = wref()
+            if t is None:
+                continue
+            g = full[i]
+            if t._hooks:
+                for fn in list(t._hooks.values()):
+                    out = fn(Tensor(g))
+                    if out is not None:
+                        g = out._value if isinstance(out, Tensor) else out
+                full[i] = g
+            if t._retain_grads and not t.stop_gradient:
+                t._raw_accumulate_grad(g)
+        full = tuple(full)
+        if len(full) == 1:
+            in_grads = node.vjp_fn(full[0])
+        else:
+            in_grads = node.vjp_fn(full)
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or _is_float0(g):
+                continue
+            src = t._grad_node
+            if src is not None:
+                buf = cots.setdefault(src.id, [None] * len(src.out_avals))
+                j = t._out_index
+                buf[j] = g if buf[j] is None else buf[j] + g
+            elif not t.stop_gradient:
+                t._accumulate_grad(g)
+        if not retain_graph:
+            cots.pop(nid, None)
+
+
+# --------------------------------------------------------------------------- #
+# Tensor
+# --------------------------------------------------------------------------- #
+
+_tensor_methods: dict[str, Callable] = {}
+
+
+def register_tensor_method(name: str, fn: Callable):
+    """Attach a functional op as a Tensor method (how python/paddle/tensor/__init__.py
+    monkey-patches methods onto the pybind Tensor in the reference)."""
+    _tensor_methods[name] = fn
+    setattr(Tensor, name, fn)
+
+
+class Tensor:
+    """User-facing tensor handle: jax.Array value + autograd slot.
+
+    Reference: paddle::Tensor (paddle/phi/api/include/tensor.h:82) +
+    AutogradMeta (paddle/fluid/eager/autograd_meta.h:61).
+    """
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "_hooks",
+        "_retain_grads",
+        "name",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._hooks = None
+        self._retain_grads = False
+        self.name = name
+
+    # -- basic metadata ---------------------------------------------------- #
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.ndim else 1
+
+    @property
+    def T(self):
+        return _tensor_methods["t"](self)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if devs is None:
+            return "unknown"
+        try:
+            return str(next(iter(devs())))
+        except Exception:
+            return "unknown"
+
+    def __len__(self):
+        if self._value.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+            f"{grad_info},\n       {self._value})"
+        )
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __format__(self, spec):
+        if self._value.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # -- conversion -------------------------------------------------------- #
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        return run_op("clone", lambda a: a + jnp.zeros((), a.dtype), [self])
+
+    def astype(self, dtype):
+        nd = dtype_mod.convert_dtype(dtype)
+        return run_op(
+            "cast", lambda a: a.astype(jnp.dtype(nd)), [self]
+        )
+
+    cast = astype
+
+    def cpu(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        # paddle Tensor.to(device|dtype)
+        for a in list(args) + list(kwargs.values()):
+            try:
+                nd = dtype_mod.convert_dtype(a)
+            except TypeError:
+                continue
+            if nd is not None and not isinstance(a, (Tensor,)):
+                try:
+                    return self.astype(nd)
+                except Exception:
+                    continue
+        return self
+
+    # -- autograd ---------------------------------------------------------- #
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        backward(self, grad_tensor, retain_graph)
+
+    def _accumulate_grad(self, g):
+        if self._hooks:
+            for fn in list(self._hooks.values()):
+                out = fn(Tensor(g))
+                if out is not None:
+                    g = out._value if isinstance(out, Tensor) else out
+        self._raw_accumulate_grad(g)
+
+    def _raw_accumulate_grad(self, g):
+        if g.dtype != self._value.dtype:
+            g = g.astype(self._value.dtype)
+        if self.grad is None:
+            self.grad = Tensor(g, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._value + g, stop_gradient=True)
+
+    def retain_grads(self):
+        """Make backward() populate .grad on this non-leaf tensor
+        (reference: Tensor._retain_grads / retain_graph semantics)."""
+        self._retain_grads = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, fn):
+        if self._hooks is None:
+            self._hooks = {}
+        hid = len(self._hooks)
+        while hid in self._hooks:
+            hid += 1
+        self._hooks[hid] = fn
+
+        class _Handle:
+            def __init__(self, hooks, key):
+                self._hooks, self._key = hooks, key
+
+            def remove(self):
+                self._hooks.pop(self._key, None)
+
+        return _Handle(self._hooks, hid)
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    @requires_grad.setter
+    def requires_grad(self, flag):
+        self.stop_gradient = not flag
+
+    # -- in-place-style helpers (JAX arrays are immutable; these rebind) ---- #
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}"
+            )
+        self._value = value.astype(self._value.dtype)
+        return self
+
+    def copy_(self, other, *_):
+        return self.set_value(other)
+
+    def _inplace_update(self, new_tensor: "Tensor"):
+        """Rebind this handle to a tape-produced value (x[i]=v, x.add_(y), ...).
+
+        If the producing node holds this same handle as an input, swap in a
+        snapshot carrying the pre-update tape state so the graph stays acyclic.
+        In-place mutation of a *leaf* that requires grad is an error, matching
+        the reference ("leaf Variable that requires grad is used in an
+        in-place operation").
+        """
+        node = new_tensor._grad_node
+        if node is not None and any(t is self for t in node.inputs):
+            if self._grad_node is None and not self.stop_gradient:
+                raise RuntimeError(
+                    "in-place operation on a leaf Tensor that requires grad "
+                    "is not allowed; use .detach() or no_grad(), or assign "
+                    "with set_value()"
+                )
+            snap = Tensor(self._value, stop_gradient=self.stop_gradient, name=self.name)
+            snap._grad_node = self._grad_node
+            snap._out_index = self._out_index
+            snap._hooks = self._hooks
+            snap._retain_grads = self._retain_grads
+            node.inputs = [snap if t is self else t for t in node.inputs]
+        self._value = new_tensor._value
+        self._grad_node = new_tensor._grad_node
+        self._out_index = new_tensor._out_index
+        if node is not None:
+            # this handle is now the node's output: route hooks/retain here
+            import weakref
+
+            node.weak_outputs = [
+                weakref.ref(self) if w() is new_tensor else w
+                for w in node.weak_outputs
+            ]
+        return self
+
+    # -- indexing ---------------------------------------------------------- #
+
+    def __getitem__(self, idx):
+        idx = _normalize_index(idx)
+        return run_op("getitem", lambda a: a[idx], [self])
+
+    def __setitem__(self, idx, value):
+        idx = _normalize_index(idx)
+        if isinstance(value, Tensor):
+            out = run_op(
+                "setitem",
+                lambda a, v: a.at[idx].set(v.astype(a.dtype)),
+                [self, value],
+            )
+        else:
+            val = value
+            out = run_op("setitem", lambda a: a.at[idx].set(val), [self])
+        self._inplace_update(out)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # dim helpers
+    def dim(self):
+        return self.ndim
+
+    def numel(self):
+        return self.size
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase);
+    stop_gradient defaults to False and it carries a trainable flag."""
+
+    __slots__ = ("trainable", "optimize_attr", "is_distributed")
+
+    def __init__(self, value, trainable: bool = True, name: str | None = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.is_distributed = False
+
+
+def _normalize_index(idx):
+    def conv(x):
+        if isinstance(x, Tensor):
+            return x._value
+        return x
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+# --------------------------------------------------------------------------- #
+# op execution
+# --------------------------------------------------------------------------- #
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py to_tensor)."""
+    del place
+    if isinstance(data, Tensor):
+        val = data._value
+        if dtype is not None:
+            nd = dtype_mod.convert_dtype(dtype)
+            if np.dtype(val.dtype) != nd:
+                val = val.astype(jnp.dtype(nd))
+        return Tensor(val, stop_gradient=stop_gradient)
+    nd = dtype_mod.convert_dtype(dtype)
+    if nd is None and isinstance(data, (float,)):
+        nd = dtype_mod.default_float_dtype()
+    if nd is None and isinstance(data, (list, tuple)):
+        flat = np.asarray(data)
+        if flat.dtype == np.float64:
+            nd = dtype_mod.default_float_dtype()
+    if nd is None and isinstance(data, np.ndarray) and data.dtype == np.float64:
+        # match paddle: numpy float64 keeps its dtype only when explicit;
+        # default behavior converts to default dtype
+        nd = data.dtype
+    val = jnp.asarray(data, dtype=None if nd is None else jnp.dtype(nd))
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def as_tensors(args) -> list[Tensor]:
+    return [a if isinstance(a, Tensor) else to_tensor(a) for a in args]
+
+
+def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = None):
+    """Execute `fn(*raw_values)` and record it on the tape when needed.
+
+    This is the entire analog of the reference's generated `<op>_ad_func` entry
+    points (paddle/fluid/eager/auto_code_generator/generator/eager_gen.py):
+    autocast interception, forward execution, grad-node wiring.
+
+    `fn` must be a pure jax-traceable function of the tensor inputs only
+    (non-tensor attrs are captured in its closure). Multiple outputs are
+    returned as a tuple of Tensors when fn returns a tuple.
+    """
+    tensors = [a if isinstance(a, Tensor) else to_tensor(a) for a in inputs]
+    values = [t._value for t in tensors]
+
+    if _op_input_interceptor is not None:
+        values = _op_input_interceptor(name, values)
+
+    need_grad = (
+        is_grad_enabled()
+        and not in_tracing()
+        and any(not t.stop_gradient or t._grad_node is not None for t in tensors)
+    )
+
+    if not need_grad:
+        out = fn(*values)
+        if isinstance(out, tuple):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+    out, vjp_fn = jax.vjp(fn, *values)
+    multi = isinstance(out, tuple)
+    outs = out if multi else (out,)
+    avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+    node = GradNode(name, vjp_fn, tensors, avals)
+    result = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = i
+        result.append(t)
+    node.set_outputs(result)
+    return tuple(result) if multi else result[0]

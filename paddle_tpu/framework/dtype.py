@@ -1,0 +1,105 @@
+"""Dtype registry and default-dtype state.
+
+TPU-native analog of the reference's dtype surface
+(reference: paddle/phi/common/data_type.h, python/paddle/framework/framework.py
+set_default_dtype/get_default_dtype). We expose paddle-style dtype names backed
+directly by numpy/jax dtypes — there is no separate enum because jax.Array
+carries its dtype natively.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+# paddle-style aliases
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np dtype, jnp dtype) to a numpy dtype obj."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _NAME_TO_DTYPE:
+            return np.dtype(_NAME_TO_DTYPE[name])
+        return np.dtype(name)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Paddle-style name for a dtype ('float32', 'bfloat16', ...)."""
+    return np.dtype(dtype).name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    nd = convert_dtype(d)
+    if nd.kind not in ("f",) and nd != np.dtype(jnp.bfloat16):
+        raise TypeError(
+            f"set_default_dtype only supports float dtypes, got {d!r}"
+        )
+    _default_dtype = nd
+
+
+def get_default_dtype():
+    return np.dtype(_default_dtype).name
+
+
+def default_float_dtype():
+    return _default_dtype
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    d = np.dtype(dtype)
+    return d.kind == "f" or d == np.dtype(jnp.bfloat16)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return np.dtype(dtype).kind in ("i", "u", "b")
+
+
+def is_complex_dtype(dtype) -> bool:
+    return np.dtype(dtype).kind == "c"

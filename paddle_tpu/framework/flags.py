@@ -1,0 +1,68 @@
+"""Global flag registry.
+
+Reference: paddle/common/flags.h:38-83 + flags_native.cc expose 187 runtime
+flags through paddle.set_flags/get_flags (python/paddle/base/framework.py:132,157).
+Here flags are a plain registry; the handful that matter on TPU are wired to
+jax.config / XLA options, the rest are accepted and stored so reference-style
+scripts keep working.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_FLAGS: dict[str, Any] = {
+    # numerics
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": 0,
+    "FLAGS_cudnn_deterministic": False,  # maps to deterministic XLA reductions
+    "FLAGS_embedding_deterministic": 0,
+    # memory (informational on TPU; XLA/PJRT owns HBM)
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    # matmul precision: 'default' | 'high' | 'highest'
+    "FLAGS_matmul_precision": "default",
+    # distributed
+    "FLAGS_distributed_collective_timeout_s": 600,
+    "FLAGS_benchmark": False,
+}
+
+
+def _load_env():
+    for k in list(os.environ):
+        if k.startswith("FLAGS_"):
+            v = os.environ[k]
+            if v.lower() in ("true", "false"):
+                _FLAGS[k] = v.lower() == "true"
+            else:
+                try:
+                    _FLAGS[k] = int(v)
+                except ValueError:
+                    try:
+                        _FLAGS[k] = float(v)
+                    except ValueError:
+                        _FLAGS[k] = v
+
+
+_load_env()
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags equivalent."""
+    import jax
+
+    for k, v in flags.items():
+        _FLAGS[k] = v
+        if k == "FLAGS_matmul_precision":
+            jax.config.update(
+                "jax_default_matmul_precision",
+                {"default": None, "high": "bfloat16_3x", "highest": "float32"}.get(v, None),
+            )
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _FLAGS.get(k) for k in keys}

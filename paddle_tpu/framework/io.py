@@ -1,0 +1,50 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:773,1020).
+
+State dicts are nested dicts of Tensors; serialized with pickle over numpy
+arrays (same wire-compatibility stance as the reference's pickled state_dicts).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core import Tensor
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value)}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_to_serializable(v) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def _from_serializable(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__") is True:
+            return Tensor(jnp.asarray(obj["data"]))
+        return {k: _from_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_from_serializable(v) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_serializable(pickle.load(f))

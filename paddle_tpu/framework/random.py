@@ -1,0 +1,67 @@
+"""Global RNG state.
+
+The reference keeps per-device Philox generators (paddle/phi/core/generator.h)
+seeded by `paddle.seed`. On TPU/JAX randomness is functional: we keep one global
+threefry key and split it per draw. Under tracing (jit), stateful splitting would
+leak host state into the trace, so traced code should use `split_for_trace` keys
+captured at trace time, or the nn-layer RNG plumbing (see paddle_tpu.jit).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get_key():
+    key = getattr(_state, "key", None)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+        _state.key = key
+    return key
+
+
+def seed(value: int):
+    """Set the global RNG seed (paddle.seed equivalent;
+    reference: python/paddle/framework/random.py:seed)."""
+    _state.key = jax.random.PRNGKey(int(value))
+    return None
+
+
+def next_key():
+    """Split the global key and return a fresh subkey (stateful, eager-only)."""
+    key = _get_key()
+    key, sub = jax.random.split(key)
+    _state.key = key
+    return sub
+
+
+def get_rng_state():
+    return (_get_key(),)
+
+
+def set_rng_state(state):
+    _state.key = state[0]
+
+
+class rng_guard:
+    """Context manager that snapshots/restores the global RNG state
+    (analog of the reference's RNG-state preservation in recompute,
+    python/paddle/distributed/fleet/recompute/recompute.py)."""
+
+    def __init__(self, key=None):
+        self._key = key
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = get_rng_state()
+        if self._key is not None:
+            _state.key = self._key
+        return self
+
+    def __exit__(self, *exc):
+        set_rng_state(self._saved)
+        return False

@@ -1,0 +1,202 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "zeros_like",
+    "ones",
+    "ones_like",
+    "full",
+    "full_like",
+    "empty",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "meshgrid",
+    "tril",
+    "triu",
+    "assign",
+    "clone",
+    "create_parameter",
+]
+
+
+def _resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtype_mod.default_float_dtype()
+    return dtype_mod.convert_dtype(dtype)
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_tuple(shape), jnp.dtype(_resolve_dtype(dtype))))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_tuple(shape), jnp.dtype(_resolve_dtype(dtype))))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = np.bool_
+        elif isinstance(fill_value, int):
+            dtype = np.int32
+        else:
+            dtype = dtype_mod.default_float_dtype()
+    return Tensor(
+        jnp.full(_shape_tuple(shape), fill_value, jnp.dtype(dtype_mod.convert_dtype(dtype)))
+    )
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    d = None if dtype is None else jnp.dtype(dtype_mod.convert_dtype(dtype))
+    return Tensor(jnp.zeros_like(x._value, dtype=d))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    d = None if dtype is None else jnp.dtype(dtype_mod.convert_dtype(dtype))
+    return Tensor(jnp.ones_like(x._value, dtype=d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    d = None if dtype is None else jnp.dtype(dtype_mod.convert_dtype(dtype))
+    return Tensor(jnp.full_like(x._value, fill_value, dtype=d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange with Tensor bounds is not supported; pass scalars")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = np.int32
+        else:
+            dtype = dtype_mod.default_float_dtype()
+    else:
+        dtype = dtype_mod.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=jnp.dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    d = jnp.dtype(_resolve_dtype(dtype))
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=d))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    d = jnp.dtype(_resolve_dtype(dtype))
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    d = jnp.dtype(_resolve_dtype(dtype))
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=d))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return run_op("diag", fn, [x])
+
+
+def diagflat(x, offset=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return run_op("diagflat", lambda a: jnp.diagflat(a, k=offset), [x])
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    ts = [to_tensor(a) if not isinstance(a, Tensor) else a for a in args]
+    outs = run_op(
+        "meshgrid", lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), ts
+    )
+    return list(outs)
+
+
+def tril(x, diagonal=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return run_op("tril", lambda a: jnp.tril(a, k=diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return run_op("triu", lambda a: jnp.triu(a, k=diagonal), [x])
+
+
+def assign(x, output=None):
+    src = to_tensor(x) if not isinstance(x, Tensor) else x
+    out = run_op("assign", lambda a: a + jnp.zeros((), a.dtype), [src])
+    if output is not None:
+        output._inplace_update(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False, default_initializer=None):
+    from ..framework.core import Parameter
+    from ..framework import random as rnd
+    import jax
+
+    d = jnp.dtype(_resolve_dtype(dtype))
+    shape = _shape_tuple(shape)
+    if default_initializer is not None:
+        t = zeros(shape, d)
+        p = Parameter(t._value, name=name)
+        default_initializer(p)
+        return p
+    if is_bias:
+        return Parameter(jnp.zeros(shape, d), name=name)
+    # Xavier-uniform default, like the reference's default param init
+    fan_in = shape[0] if shape else 1
+    fan_out = shape[-1] if shape else 1
+    limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+    val = jax.random.uniform(rnd.next_key(), shape, jnp.float32, -limit, limit).astype(d)
+    return Parameter(val, name=name)

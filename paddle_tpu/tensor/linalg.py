@@ -1,0 +1,280 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul and friends lower to jnp/lax dot_general — XLA tiles these onto the MXU;
+`preferred_element_type` keeps bf16 inputs accumulating in f32 like the
+reference's cublas GEMM with FP32 compute type.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = [
+    "matmul",
+    "mm",
+    "bmm",
+    "dot",
+    "mv",
+    "norm",
+    "dist",
+    "cross",
+    "cholesky",
+    "cholesky_solve",
+    "inverse",
+    "pinv",
+    "det",
+    "slogdet",
+    "matrix_rank",
+    "matrix_power",
+    "qr",
+    "svd",
+    "eig",
+    "eigh",
+    "eigvals",
+    "eigvalsh",
+    "solve",
+    "triangular_solve",
+    "lstsq",
+    "lu",
+    "histogram",
+    "bincount",
+    "cov",
+    "corrcoef",
+    "einsum",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        out_dtype = jnp.promote_types(a.dtype, b.dtype)
+        acc = jnp.float32 if out_dtype in (jnp.bfloat16, jnp.float16) else None
+        out = jnp.matmul(a, b, preferred_element_type=acc)
+        return out.astype(out_dtype)
+
+    return run_op("matmul", fn, [_t(x), _t(y)])
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return run_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), [_t(x), _t(y)])
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def fn(a):
+        if p == "fro":
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+    return run_op("norm", fn, [_t(x)])
+
+
+def dist(x, y, p=2, name=None):
+    return norm(_t(x) - _t(y), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next((i for i, s in enumerate(a.shape) if s == 3), -1)
+        return jnp.cross(a, b, axis=ax)
+
+    return run_op("cross", fn, [_t(x), _t(y)])
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return run_op("cholesky", fn, [_t(x)])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(Lm, -1, -2), z, lower=False)
+
+    return run_op("cholesky_solve", fn, [_t(x), _t(y)])
+
+
+def inverse(x, name=None):
+    return run_op("inverse", jnp.linalg.inv, [_t(x)])
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), [_t(x)])
+
+
+def det(x, name=None):
+    return run_op("det", jnp.linalg.det, [_t(x)])
+
+
+def slogdet(x, name=None):
+    outs = run_op("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), [_t(x)])
+    return run_op("stack_slogdet", lambda s, l: jnp.stack([s, l]), list(outs))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return run_op(
+        "matrix_rank",
+        lambda a: jnp.linalg.matrix_rank(a, tol=tol).astype(jnp.int32),
+        [_t(x)],
+    )
+
+
+def matrix_power(x, n, name=None):
+    return run_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, int(n)), [_t(x)])
+
+
+def qr(x, mode="reduced", name=None):
+    outs = run_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [_t(x)]) \
+        if mode != "r" else (run_op("qr_r", lambda a: jnp.linalg.qr(a, mode="r"), [_t(x)]),)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = run_op(
+        "svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), [_t(x)]
+    )
+    return outs
+
+
+def eig(x, name=None):
+    vals, vecs = np.linalg.eig(np.asarray(_t(x)._value))
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(vecs))
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = run_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), [_t(x)])
+    return outs[0], outs[1]
+
+
+def eigvals(x, name=None):
+    vals = np.linalg.eigvals(np.asarray(_t(x)._value))
+    return Tensor(jnp.asarray(vals))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return run_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a), [_t(x)])
+
+
+def solve(x, y, name=None):
+    def fn(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return run_op("solve", fn, [_t(x), _t(y)])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return run_op("triangular_solve", fn, [_t(x), _t(y)])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+
+    return run_op("lstsq", fn, [_t(x), _t(y)])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    lu_t, piv_t = run_op("lu", fn, [_t(x)])
+    if get_infos:
+        return lu_t, piv_t, Tensor(jnp.zeros((), jnp.int32))
+    return lu_t, piv_t
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    arr = np.asarray(_t(input)._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist.astype(np.int32)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xx = _t(x)
+    n = int(np.asarray(xx._value).max()) + 1 if xx.size else 0
+    length = max(n, minlength)
+    if weights is None:
+        return run_op(
+            "bincount",
+            lambda a: jnp.bincount(a.astype(jnp.int32), length=length),
+            [xx],
+        )
+    return run_op(
+        "bincount",
+        lambda a, w: jnp.bincount(a.astype(jnp.int32), weights=w, length=length),
+        [xx, _t(weights)],
+    )
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return run_op(
+        "cov",
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+        [_t(x)],
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return run_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [_t(x)])
+
+
+def einsum(equation, *operands):
+    ts = [_t(o) for o in operands]
+    return run_op("einsum", lambda *vs: jnp.einsum(equation, *vs), ts)
+
+
+for _name in __all__:
+    if _name not in ("einsum",):
+        register_tensor_method(_name, globals()[_name])

@@ -1,0 +1,98 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = [
+    "equal",
+    "not_equal",
+    "greater_than",
+    "greater_equal",
+    "less_than",
+    "less_equal",
+    "equal_all",
+    "allclose",
+    "isclose",
+    "logical_and",
+    "logical_or",
+    "logical_not",
+    "logical_xor",
+    "bitwise_and",
+    "bitwise_or",
+    "bitwise_not",
+    "bitwise_xor",
+    "bitwise_left_shift",
+    "bitwise_right_shift",
+    "is_empty",
+    "is_tensor",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _make(name, jfn, n=2):
+    if n == 2:
+        def op(x, y, name=None):
+            return run_op(op.__name__, jfn, [_t(x), _t(y)])
+    else:
+        def op(x, name=None):
+            return run_op(op.__name__, jfn, [_t(x)])
+    op.__name__ = name
+    return op
+
+
+equal = _make("equal", jnp.equal)
+not_equal = _make("not_equal", jnp.not_equal)
+greater_than = _make("greater_than", jnp.greater)
+greater_equal = _make("greater_equal", jnp.greater_equal)
+less_than = _make("less_than", jnp.less)
+less_equal = _make("less_equal", jnp.less_equal)
+logical_and = _make("logical_and", jnp.logical_and)
+logical_or = _make("logical_or", jnp.logical_or)
+logical_xor = _make("logical_xor", jnp.logical_xor)
+logical_not = _make("logical_not", jnp.logical_not, n=1)
+bitwise_and = _make("bitwise_and", lambda a, b: a & b)
+bitwise_or = _make("bitwise_or", lambda a, b: a | b)
+bitwise_xor = _make("bitwise_xor", lambda a, b: a ^ b)
+bitwise_not = _make("bitwise_not", lambda a: ~a, n=1)
+bitwise_left_shift = _make("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _make("bitwise_right_shift", jnp.right_shift)
+
+
+def equal_all(x, y, name=None):
+    return run_op("equal_all", lambda a, b: jnp.array_equal(a, b), [_t(x), _t(y)])
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [_t(x), _t(y)],
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [_t(x), _t(y)],
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+for _name in __all__:
+    if _name != "is_tensor":
+        register_tensor_method(_name, globals()[_name])

@@ -1,0 +1,640 @@
+"""Shape / layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = [
+    "reshape",
+    "flatten",
+    "transpose",
+    "t",
+    "moveaxis",
+    "swapaxes",
+    "squeeze",
+    "unsqueeze",
+    "concat",
+    "stack",
+    "hstack",
+    "vstack",
+    "dstack",
+    "split",
+    "chunk",
+    "unbind",
+    "tile",
+    "expand",
+    "expand_as",
+    "broadcast_to",
+    "broadcast_tensors",
+    "flip",
+    "rot90",
+    "roll",
+    "gather",
+    "gather_nd",
+    "scatter",
+    "scatter_nd_add",
+    "index_select",
+    "index_add",
+    "index_put",
+    "take_along_axis",
+    "put_along_axis",
+    "masked_select",
+    "masked_fill",
+    "slice",
+    "strided_slice",
+    "pad",
+    "repeat_interleave",
+    "unique",
+    "unique_consecutive",
+    "flatten_",
+    "as_strided",
+    "view",
+    "view_as",
+    "unfold",
+    "tensordot",
+    "atleast_1d",
+    "atleast_2d",
+    "atleast_3d",
+    "tolist",
+    "crop",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    shp = _static_shape(shape)
+    return run_op("reshape", lambda a: a.reshape(shp), [_t(x)])
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    xx = _t(x)
+    nd = xx.ndim
+    if nd == 0:
+        return reshape(xx, [1])
+    sa = start_axis % nd
+    so = stop_axis % nd
+
+    def fn(a):
+        shp = a.shape[:sa] + (-1,) + a.shape[so + 1:]
+        return a.reshape(shp)
+
+    return run_op("flatten", fn, [xx])
+
+
+flatten_ = flatten
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = tuple(int(p) for p in perm)
+    return run_op("transpose", lambda a: jnp.transpose(a, perm), [_t(x)])
+
+
+def t(x, name=None):
+    xx = _t(x)
+    if xx.ndim < 2:
+        return xx
+    return run_op("t", lambda a: jnp.swapaxes(a, -1, -2), [xx])
+
+
+def moveaxis(x, source, destination, name=None):
+    return run_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), [_t(x)])
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return run_op("swapaxes", lambda a: jnp.swapaxes(a, axis1, axis2), [_t(x)])
+
+
+def squeeze(x, axis=None, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = axis if isinstance(axis, tuple) else (axis,)
+        ax = tuple(a_ % a.ndim for a_ in ax)
+        ax = tuple(a_ for a_ in ax if a.shape[a_] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+
+    return run_op("squeeze", fn, [_t(x)])
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) for a in axis)
+    else:
+        ax = (int(axis),)
+
+    def fn(a):
+        out = a
+        for v in ax:
+            out = jnp.expand_dims(out, v)
+        return out
+
+    return run_op("unsqueeze", fn, [_t(x)])
+
+
+def concat(x, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis)
+    return run_op("concat", lambda *vs: jnp.concatenate(vs, axis=ax), ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    ax = int(axis)
+    return run_op("stack", lambda *vs: jnp.stack(vs, axis=ax), ts)
+
+
+def hstack(x, name=None):
+    ts = [_t(v) for v in x]
+    return run_op("hstack", lambda *vs: jnp.hstack(vs), ts)
+
+
+def vstack(x, name=None):
+    ts = [_t(v) for v in x]
+    return run_op("vstack", lambda *vs: jnp.vstack(vs), ts)
+
+
+def dstack(x, name=None):
+    ts = [_t(v) for v in x]
+    return run_op("dstack", lambda *vs: jnp.dstack(vs), ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    xx = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis) % max(xx.ndim, 1)
+    dim = xx.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {ax} of size {dim} is not divisible by "
+                f"num={num_or_sections}; pass explicit section sizes instead"
+            )
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) if not isinstance(s, Tensor) else int(s.item()) for s in num_or_sections]
+        n_unknown = sum(1 for s in sections if s < 0)
+        if n_unknown:
+            known = sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    def fn(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, int(offsets[i]), int(offsets[i + 1]), axis=ax)
+            for i in range(len(sections))
+        )
+
+    return list(run_op("split", fn, [xx]))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    xx = _t(x)
+    n = int(chunks)
+    ax = int(axis) % max(xx.ndim, 1)
+    dim = xx.shape[ax]
+    if dim % n == 0:
+        return split(xx, n, ax)
+    # uneven: ceil-sized chunks with a smaller last chunk
+    size = -(-dim // n)
+    sections = []
+    left = dim
+    while left > 0:
+        sections.append(min(size, left))
+        left -= size
+    return split(xx, sections, ax)
+
+
+def unbind(x, axis=0, name=None):
+    xx = _t(x)
+    ax = int(axis) % xx.ndim
+    n = xx.shape[ax]
+
+    def fn(a):
+        return tuple(jnp.squeeze(v, ax) for v in jnp.split(a, n, axis=ax))
+
+    return list(run_op("unbind", fn, [xx]))
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(r) for r in repeat_times)
+    return run_op("tile", lambda a: jnp.tile(a, reps), [_t(x)])
+
+
+def expand(x, shape, name=None):
+    shp = _static_shape(shape)
+    xx = _t(x)
+
+    def fn(a):
+        target = tuple(
+            a.shape[i - (len(shp) - a.ndim)] if s == -1 else s
+            for i, s in enumerate(shp)
+        )
+        return jnp.broadcast_to(a, target)
+
+    return run_op("expand", fn, [xx])
+
+
+def expand_as(x, y, name=None):
+    yy = _t(y)
+    return expand(x, yy.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_t(v) for v in inputs]
+    outs = run_op(
+        "broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)), ts
+    )
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    ax = tuple(int(a) for a in axis)
+    return run_op("flip", lambda a: jnp.flip(a, axis=ax), [_t(x)])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [_t(x)])
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    return run_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), [_t(x)])
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis)
+    return run_op(
+        "gather",
+        lambda a, i: jnp.take(a, i.astype(jnp.int32).reshape(-1), axis=ax),
+        [_t(x), _t(index)],
+    )
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return run_op("gather_nd", fn, [_t(x), _t(index)])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        z = a.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+
+    return run_op("scatter", fn, [_t(x), _t(index), _t(updates)])
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, i, u):
+        i = i.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return run_op("scatter_nd_add", fn, [_t(x), _t(index), _t(updates)])
+
+
+def index_select(x, index, axis=0, name=None):
+    ax = int(axis)
+    return run_op(
+        "index_select",
+        lambda a, i: jnp.take(a, i.astype(jnp.int32).reshape(-1), axis=ax),
+        [_t(x), _t(index)],
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    ax = int(axis)
+
+    def fn(a, i, v):
+        i = i.astype(jnp.int32).reshape(-1)
+        vm = jnp.moveaxis(v, ax % a.ndim, 0)
+        am = jnp.moveaxis(a, ax % a.ndim, 0)
+        am = am.at[i].add(vm.astype(a.dtype))
+        return jnp.moveaxis(am, 0, ax % a.ndim)
+
+    return run_op("index_add", fn, [_t(x), _t(index), _t(value)])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_ts = [_t(i) for i in indices]
+    vv = _t(value)
+
+    def fn(a, v, *idx):
+        idx = tuple(i.astype(jnp.int32) if i.dtype != jnp.bool_ else i for i in idx)
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v.astype(a.dtype))
+
+    return run_op("index_put", fn, [_t(x), vv] + idx_ts)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    ax = int(axis)
+    return run_op(
+        "take_along_axis",
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=ax),
+        [_t(arr), _t(indices)],
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    ax = int(axis)
+    mode = reduce
+
+    def fn(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if mode == "assign":
+            return jnp_put_along_axis_set(a, i, v, ax)
+        if mode == "add":
+            return jnp_put_along_axis_add(a, i, v, ax)
+        if mode in ("mul", "multiply"):
+            ones = jnp_put_along_axis_set(jnp.ones_like(a), i, v, ax)
+            return a * ones
+        raise ValueError(f"unsupported reduce mode {mode}")
+
+    return run_op("put_along_axis", fn, [_t(arr), _t(indices), _t(values)])
+
+
+def _along_axis_indices(i, axis):
+    idx = list(jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij"))
+    idx[axis % i.ndim] = i
+    return tuple(idx)
+
+
+def jnp_put_along_axis_set(a, i, v, axis):
+    return a.at[_along_axis_indices(i, axis)].set(v)
+
+
+def jnp_put_along_axis_add(a, i, v, axis):
+    return a.at[_along_axis_indices(i, axis)].add(v)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (same restriction the reference's
+    # to_static places on masked_select without explicit shape hints)
+    xx, mm = _t(x), _t(mask)
+    vals = np.asarray(xx._value)[np.asarray(mm._value)]
+    out = Tensor(jnp.asarray(vals))
+    return out
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return run_op(
+            "masked_fill",
+            lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+            [_t(x), _t(mask), value],
+        )
+    return run_op(
+        "masked_fill",
+        lambda a, m: jnp.where(m, jnp.asarray(value, a.dtype), a),
+        [_t(x), _t(mask)],
+    )
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    xx = _t(x)
+
+    def fn(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            st2 = max(st + dim, 0) if st < 0 else min(st, dim)
+            en2 = max(en + dim, 0) if en < 0 else min(en, dim)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+
+    return run_op("slice", fn, [xx])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    xx = _t(x)
+    axes = [int(a) for a in axes]
+    starts = [int(s) for s in starts]
+    ends = [int(e) for e in ends]
+    strides_ = [int(s) for s in strides]
+
+    def fn(a):
+        index = [np.s_[:]] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides_):
+            index[ax] = np.s_[st:en:sd]
+        return a[tuple(index)]
+
+    return run_op("strided_slice", fn, [xx])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    xx = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def fn(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle semantics: pad applies to the trailing spatial dims,
+            # ordered from the last dim inward when data_format is NCHW/NCL/NCDHW
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                dims = range(nd - n_spatial, nd)
+            else:
+                dims = range(1, 1 + n_spatial)
+            for k, d in enumerate(dims):
+                widths[d] = (pad[2 * k], pad[2 * k + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+
+    return run_op("pad", fn, [xx])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    xx = _t(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._value)
+        total = int(reps.sum())
+
+        def fn(a, r):
+            return jnp.repeat(a, r, axis=axis, total_repeat_length=total)
+
+        return run_op("repeat_interleave", fn, [xx, _t(repeats)])
+    return run_op(
+        "repeat_interleave", lambda a: jnp.repeat(a, int(repeats), axis=axis), [xx]
+    )
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    # dynamic shape -> host computation (eager-only), like reference unique on CPU
+    arr = np.asarray(_t(x)._value)
+    res = np.unique(
+        arr, return_index=True, return_inverse=True, return_counts=True, axis=axis
+    )
+    vals, idx, inv, cnt = res
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_index:
+        outs.append(Tensor(jnp.asarray(idx.astype(np.int32))))
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int32))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(cnt.astype(np.int32))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(_t(x)._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.ones(arr.shape[0], dtype=bool)
+        keep[1:] = arr[1:] != arr[:-1]
+        vals = arr[keep]
+    else:
+        raise NotImplementedError("unique_consecutive with axis is not supported yet")
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int32))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, arr.shape[0]))
+        outs.append(Tensor(jnp.asarray(cnt.astype(np.int32))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(_t(x)._value).reshape(-1)[offset:],
+        shape=_static_shape(shape),
+        strides=tuple(int(s) * np.dtype(_t(x).dtype).itemsize for s in stride),
+    )
+    return Tensor(jnp.asarray(arr))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return _t(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, _t(other).shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    xx = _t(x)
+    ax = int(axis) % xx.ndim
+    dim = xx.shape[ax]
+    n_windows = (dim - size) // step + 1
+
+    def fn(a):
+        idx = jnp.arange(n_windows)[:, None] * step + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=ax)
+        new_shape = a.shape[:ax] + (n_windows, size) + a.shape[ax + 1:]
+        out = out.reshape(new_shape)
+        return jnp.moveaxis(out, ax + 1, -1) if ax + 1 != out.ndim - 1 else out
+
+    return run_op("unfold", fn, [xx])
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), [_t(x), _t(y)])
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(_t(v), [1]) if _t(v).ndim == 0 else _t(v) for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for v in inputs:
+        vv = _t(v)
+        while vv.ndim < 2:
+            vv = unsqueeze(vv, 0)
+        outs.append(vv)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for v in inputs:
+        vv = _t(v)
+        while vv.ndim < 3:
+            vv = unsqueeze(vv, -1) if vv.ndim >= 2 else unsqueeze(vv, 0)
+        outs.append(vv)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tolist(x):
+    return _t(x).tolist()
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    xx = _t(x)
+    shp = _static_shape(shape) if shape is not None else xx.shape
+    offs = [0] * xx.ndim if offsets is None else [int(o) for o in offsets]
+    axes = list(range(xx.ndim))
+    starts = offs
+    ends = [o + (s if s != -1 else xx.shape[i] - o) for i, (o, s) in enumerate(zip(offs, shp))]
+    return slice(xx, axes, starts, ends)
+
+
+_SKIP = {"slice", "t", "view", "view_as", "tolist"}
+for _name in __all__:
+    if _name not in _SKIP:
+        register_tensor_method(_name, globals()[_name])
+register_tensor_method("tolist", tolist)
+register_tensor_method("t", t)
+register_tensor_method("view", view)
+register_tensor_method("view_as", view_as)

@@ -1,0 +1,484 @@
+"""Math ops: elementwise unary/binary, reductions, cumulative ops
+(reference: python/paddle/tensor/math.py, ops.yaml entries lower straight to
+jax.numpy — XLA replaces the phi per-dtype kernel registry)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = []  # filled programmatically below
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _export(name, fn):
+    __all__.append(name)
+    globals()[name] = fn
+    return fn
+
+
+# --------------------------------------------------------------------------- #
+# unary elementwise
+# --------------------------------------------------------------------------- #
+
+def _make_unary(name, jfn):
+    def op(x, name=None):
+        return run_op(op.__name__, jfn, [_t(x)])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+_UNARY = {
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda a: jax.lax.rsqrt(a),
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "frac": lambda a: a - jnp.trunc(a),
+    "reciprocal": lambda a: 1.0 / a,
+    "square": jnp.square,
+    "neg": jnp.negative,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "sigmoid": jax.nn.sigmoid,
+    "logit": jax.scipy.special.logit,
+    "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+    "i0": lambda a: jax.scipy.special.i0(a),
+    "i1": lambda a: jax.scipy.special.i1(a),
+}
+
+for _name, _jfn in _UNARY.items():
+    _export(_name, _make_unary(_name, _jfn))
+
+# paddle aliases
+_export("arcsin", globals()["asin"])
+_export("arccos", globals()["acos"])
+_export("arctan", globals()["atan"])
+
+
+# --------------------------------------------------------------------------- #
+# binary elementwise
+# --------------------------------------------------------------------------- #
+
+def _make_binary(name, jfn):
+    def op(x, y, name=None):
+        return run_op(op.__name__, jfn, [_t(x), _t(y)])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod,
+    "remainder": jnp.mod,
+    "floor_mod": jnp.mod,
+    "pow": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "atan2": jnp.arctan2,
+    "hypot": jnp.hypot,
+    "logaddexp": jnp.logaddexp,
+    "heaviside": jnp.heaviside,
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "ldexp": lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+    "inner": jnp.inner,
+    "outer": jnp.outer,
+    "kron": jnp.kron,
+}
+
+for _name, _jfn in _BINARY.items():
+    _export(_name, _make_binary(_name, _jfn))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = float(scale), float(bias)
+
+    def fn(a):
+        out = a * jnp.asarray(s, a.dtype) + jnp.asarray(b, a.dtype) if bias_after_scale \
+            else (a + jnp.asarray(b, a.dtype)) * jnp.asarray(s, a.dtype)
+        return out
+
+    return run_op("scale", fn, [_t(x)])
+
+
+_export("scale", scale)
+
+
+def multiplex(inputs, index, name=None):
+    ts = [_t(i) for i in inputs]
+    idx = _t(index)
+
+    def fn(ind, *vals):
+        stacked = jnp.stack(vals, axis=0)
+        ind = ind.reshape(-1).astype(jnp.int32)
+        return stacked[ind, jnp.arange(stacked.shape[1])]
+
+    return run_op("multiplex", fn, [idx] + ts)
+
+
+_export("multiplex", multiplex)
+
+
+# --------------------------------------------------------------------------- #
+# reductions
+# --------------------------------------------------------------------------- #
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, jfn, int_default=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _norm_axis(axis)
+        d = None if dtype is None else jnp.dtype(dtype_mod.convert_dtype(dtype))
+
+        def fn(a):
+            kwargs = dict(axis=ax, keepdims=keepdim)
+            out = jfn(a, **kwargs)
+            if d is not None:
+                out = out.astype(d)
+            return out
+
+        return run_op(op.__name__, fn, [_t(x)])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+_export("sum", _make_reduce("sum", jnp.sum))
+_export("prod", _make_reduce("prod", jnp.prod))
+_export("max", _make_reduce("max", jnp.max))
+_export("min", _make_reduce("min", jnp.min))
+_export("amax", _make_reduce("amax", jnp.max))
+_export("amin", _make_reduce("amin", jnp.min))
+_export("mean", _make_reduce("mean", jnp.mean))
+_export("nanmean", _make_reduce("nanmean", jnp.nanmean))
+_export("nansum", _make_reduce("nansum", jnp.nansum))
+_export("logsumexp", _make_reduce("logsumexp", jax.scipy.special.logsumexp))
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = _norm_axis(axis)
+    return run_op("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), [_t(x)])
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = _norm_axis(axis)
+    return run_op("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), [_t(x)])
+
+
+_export("all", all)
+_export("any", any)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return run_op(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int32),
+        [_t(x)],
+    )
+
+
+_export("count_nonzero", count_nonzero)
+
+
+# --------------------------------------------------------------------------- #
+# cumulative
+# --------------------------------------------------------------------------- #
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = None if dtype is None else jnp.dtype(dtype_mod.convert_dtype(dtype))
+
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return run_op("cumsum", fn, [_t(x)])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = None if dtype is None else jnp.dtype(dtype_mod.convert_dtype(dtype))
+
+    def fn(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=d)
+        return jnp.cumprod(a, axis=int(dim), dtype=d)
+
+    return run_op("cumprod", fn, [_t(x)])
+
+
+def _cum_extreme(x, axis, pick_new, op_name, idx_dtype):
+    """Running max/min with indices via an associative scan over (value, index)
+    pairs; ties keep the earliest index, matching the reference kernels."""
+    xx = _t(x)
+    if axis is None:
+        xx = run_op("flatten", lambda a: a.reshape(-1), [xx])
+        ax = 0
+    else:
+        ax = int(axis)
+    d = jnp.dtype(dtype_mod.convert_dtype(idx_dtype or "int64"))
+
+    def fn(a):
+        axn = ax % a.ndim
+        iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, axn)
+
+        def comb(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take_new = pick_new(v1, v2)
+            return jnp.where(take_new, v2, v1), jnp.where(take_new, i2, i1)
+
+        vals, idx = jax.lax.associative_scan(comb, (a, iota), axis=axn)
+        return vals, idx.astype(d)
+
+    return run_op(op_name, fn, [xx])
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, lambda v1, v2: v2 > v1, "cummax", dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, lambda v1, v2: v2 < v1, "cummin", dtype)
+
+
+_export("cumsum", cumsum)
+_export("cumprod", cumprod)
+_export("cummax", cummax)
+_export("cummin", cummin)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return run_op("clip", lambda a: jnp.clip(a, lo, hi), [_t(x)])
+
+
+_export("clip", clip)
+
+
+def isnan(x, name=None):
+    return run_op("isnan", jnp.isnan, [_t(x)])
+
+
+def isinf(x, name=None):
+    return run_op("isinf", jnp.isinf, [_t(x)])
+
+
+def isfinite(x, name=None):
+    return run_op("isfinite", jnp.isfinite, [_t(x)])
+
+
+_export("isnan", isnan)
+_export("isinf", isinf)
+_export("isfinite", isfinite)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return run_op(
+        "nan_to_num",
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        [_t(x)],
+    )
+
+
+_export("nan_to_num", nan_to_num)
+
+
+def increment(x, value=1.0, name=None):
+    out = run_op("increment", lambda a: a + jnp.asarray(value, a.dtype), [_t(x)])
+    if isinstance(x, Tensor):
+        x._inplace_update(out)
+        return x
+    return out
+
+
+_export("increment", increment)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return run_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), [_t(x)])
+
+
+_export("stanh", stanh)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        w = float(weight)
+        return run_op("lerp", lambda a, b: a + w * (b - a), [_t(x), _t(y)])
+    return run_op("lerp", lambda a, b, w: a + w * (b - a), [_t(x), _t(y), _t(weight)])
+
+
+_export("lerp", lerp)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return run_op(
+        "addmm",
+        lambda i, a, b: beta * i + alpha * (a @ b),
+        [_t(input), _t(x), _t(y)],
+    )
+
+
+_export("addmm", addmm)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op(
+        "trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), [_t(x)]
+    )
+
+
+_export("trace", trace)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    ins = [_t(x)]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        ins.append(_t(prepend))
+    if has_app:
+        ins.append(_t(append))
+
+    def fn(a, *rest):
+        kw = {}
+        i = 0
+        if has_pre:
+            kw["prepend"] = rest[i]
+            i += 1
+        if has_app:
+            kw["append"] = rest[i]
+        return jnp.diff(a, n=n, axis=axis, **kw)
+
+    return run_op("diff", fn, ins)
+
+
+_export("diff", diff)
+
+# --------------------------------------------------------------------------- #
+# operator dunders
+# --------------------------------------------------------------------------- #
+
+_add, _sub, _mul, _div = (
+    globals()["add"],
+    globals()["subtract"],
+    globals()["multiply"],
+    globals()["divide"],
+)
+
+
+def _install_operators():
+    T = Tensor
+    T.__add__ = lambda s, o: _add(s, o)
+    T.__radd__ = lambda s, o: _add(o, s)
+    T.__sub__ = lambda s, o: _sub(s, o)
+    T.__rsub__ = lambda s, o: _sub(o, s)
+    T.__mul__ = lambda s, o: _mul(s, o)
+    T.__rmul__ = lambda s, o: _mul(o, s)
+    T.__truediv__ = lambda s, o: _div(s, o)
+    T.__rtruediv__ = lambda s, o: _div(o, s)
+    T.__floordiv__ = lambda s, o: globals()["floor_divide"](s, o)
+    T.__rfloordiv__ = lambda s, o: globals()["floor_divide"](o, s)
+    T.__mod__ = lambda s, o: globals()["mod"](s, o)
+    T.__rmod__ = lambda s, o: globals()["mod"](o, s)
+    T.__pow__ = lambda s, o: globals()["pow"](s, o)
+    T.__rpow__ = lambda s, o: globals()["pow"](o, s)
+    T.__neg__ = lambda s: globals()["neg"](s)
+    T.__abs__ = lambda s: globals()["abs"](s)
+
+    import operator  # noqa: F401
+
+    def _cmp(jfn, name):
+        def op(s, o):
+            return run_op(name, jfn, [_t(s), _t(o)])
+
+        return op
+
+    T.__eq__ = _cmp(jnp.equal, "equal")
+    T.__ne__ = _cmp(jnp.not_equal, "not_equal")
+    T.__lt__ = _cmp(jnp.less, "less_than")
+    T.__le__ = _cmp(jnp.less_equal, "less_equal")
+    T.__gt__ = _cmp(jnp.greater, "greater_than")
+    T.__ge__ = _cmp(jnp.greater_equal, "greater_equal")
+    # & | ^ ~ are bitwise (on bool dtype jnp bitwise == logical, matching
+    # the reference's bitwise_and/or/xor/not operator mapping)
+    T.__invert__ = lambda s: run_op(
+        "bitwise_not",
+        (lambda a: jnp.logical_not(a) if a.dtype == jnp.bool_ else ~a),
+        [s],
+    )
+    T.__and__ = _cmp(lambda a, b: a & b, "bitwise_and")
+    T.__rand__ = lambda s, o: run_op("bitwise_and", lambda a, b: a & b, [_t(o), s])
+    T.__or__ = _cmp(lambda a, b: a | b, "bitwise_or")
+    T.__ror__ = lambda s, o: run_op("bitwise_or", lambda a, b: a | b, [_t(o), s])
+    T.__xor__ = _cmp(lambda a, b: a ^ b, "bitwise_xor")
+    T.__rxor__ = lambda s, o: run_op("bitwise_xor", lambda a, b: a ^ b, [_t(o), s])
+
+
+_install_operators()
+
+# register every exported function as a Tensor method, paddle-style
+_SKIP_METHODS = {"multiplex"}
+for _name in list(__all__):
+    if _name not in _SKIP_METHODS:
+        register_tensor_method(_name, globals()[_name])

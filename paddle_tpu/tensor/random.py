@@ -1,0 +1,163 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+Each draw splits the global threefry key (framework/random.py). Under jit
+tracing the key is captured as a constant at trace time — deterministic per
+trace, matching the reference's seeded-Philox semantics closely enough for
+training; dropout layers thread explicit keys instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework import random as rnd
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = [
+    "rand",
+    "randn",
+    "randint",
+    "randint_like",
+    "randperm",
+    "uniform",
+    "uniform_",
+    "normal",
+    "normal_",
+    "standard_normal",
+    "gaussian",
+    "poisson",
+    "bernoulli",
+    "multinomial",
+    "exponential_",
+    "binomial",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return jnp.dtype(default if default is not None else dtype_mod.default_float_dtype())
+    return jnp.dtype(dtype_mod.convert_dtype(dtype))
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rnd.next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rnd.next_key(), _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.PRNGKey(seed) if seed else rnd.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not hasattr(m, "shape") else m.shape,
+            np.shape(s) if not hasattr(s, "shape") else s.shape,
+        )
+        return Tensor(m + s * jax.random.normal(rnd.next_key(), shp, jnp.float32))
+    return gaussian(shape if shape is not None else [1], mean, std)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.set_value(mean + std * jax.random.normal(rnd.next_key(), tuple(x.shape), jnp.dtype(x.dtype) if dtype_mod.is_floating_point_dtype(x.dtype) else jnp.float32))
+    return x
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.PRNGKey(seed) if seed else rnd.next_key()
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max)
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    x.set_value(
+        jax.random.uniform(rnd.next_key(), tuple(x.shape), jnp.dtype(x.dtype), min, max)
+    )
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt(dtype, np.int32)
+    return Tensor(
+        jax.random.randint(rnd.next_key(), _shape(shape), int(low), int(high), d)
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    xx = x if isinstance(x, Tensor) else to_tensor(x)
+    return randint(low, high, xx.shape, dtype or xx.dtype)
+
+
+def randperm(n, dtype=None, name=None):
+    d = _dt(dtype, np.int32)
+    return Tensor(jax.random.permutation(rnd.next_key(), int(n)).astype(d))
+
+
+def poisson(x, name=None):
+    xx = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(
+        jax.random.poisson(rnd.next_key(), xx._value).astype(xx._value.dtype)
+    )
+
+
+def bernoulli(x, name=None):
+    xx = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(
+        jax.random.bernoulli(rnd.next_key(), xx._value).astype(xx._value.dtype)
+    )
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(rnd.next_key(), c, p).astype(jnp.int32))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xx = x if isinstance(x, Tensor) else to_tensor(x)
+    logits = jnp.log(jnp.clip(xx._value, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(
+            rnd.next_key(), logits, axis=-1, shape=logits.shape[:-1] + (int(num_samples),)
+        )
+    else:
+        # Gumbel top-k trick for without-replacement sampling
+        g = jax.random.gumbel(rnd.next_key(), logits.shape)
+        _, out = jax.lax.top_k(logits + g, int(num_samples))
+    return Tensor(out.astype(jnp.int32))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x.set_value(
+        jax.random.exponential(rnd.next_key(), tuple(x.shape), jnp.dtype(x.dtype)) / lam
+    )
+    return x
+
+
+for _name in ("uniform_", "normal_", "exponential_"):
+    register_tensor_method(_name, globals()[_name])
+register_tensor_method("multinomial", multinomial)
+register_tensor_method("bernoulli", bernoulli)

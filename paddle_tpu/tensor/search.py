@@ -1,0 +1,177 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "argsort",
+    "sort",
+    "topk",
+    "where",
+    "nonzero",
+    "searchsorted",
+    "index_sample",
+    "kthvalue",
+    "mode",
+    "masked_fill_",
+    "bucketize",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _idx_dtype(dtype):
+    from ..framework import dtype as dtype_mod
+
+    return jnp.dtype(dtype_mod.convert_dtype(dtype or "int64"))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _idx_dtype(dtype)
+
+    def fn(a):
+        if axis is None:
+            return jnp.argmax(a.reshape(-1)).astype(d)
+        return jnp.argmax(a, axis=int(axis), keepdims=keepdim).astype(d)
+
+    return run_op("argmax", fn, [_t(x)])
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _idx_dtype(dtype)
+
+    def fn(a):
+        if axis is None:
+            return jnp.argmin(a.reshape(-1)).astype(d)
+        return jnp.argmin(a, axis=int(axis), keepdims=keepdim).astype(d)
+
+    return run_op("argmin", fn, [_t(x)])
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis, stable=True, descending=descending)
+        return idx.astype(jnp.int32)
+
+    return run_op("argsort", fn, [_t(x)])
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        return jnp.sort(a, axis=axis, stable=True, descending=descending)
+
+    return run_op("sort", fn, [_t(x)])
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    kk = int(k)
+
+    import jax as _jax
+
+    def fn(a):
+        ax = axis % a.ndim
+        am = jnp.moveaxis(a, ax, -1)
+        src = am if largest else -am
+        vals, idx = _jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
+
+    return run_op("topk", fn, [_t(x)])
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return run_op(
+        "where",
+        lambda c, a, b: jnp.where(c, a, b),
+        [_t(condition), _t(x), _t(y)],
+    )
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(_t(x)._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int32))[:, None]) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def fn(s, v):
+        out = jnp.searchsorted(s, v, side=side)
+        return out.astype(jnp.int32)
+
+    return run_op("searchsorted", fn, [_t(sorted_sequence), _t(values)])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_sample(x, index):
+    def fn(a, i):
+        return jnp.take_along_axis(a, i.astype(jnp.int32), axis=1)
+
+    return run_op("index_sample", fn, [_t(x), _t(index)])
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    kk = int(k)
+
+    def fn(a):
+        ax = axis % a.ndim
+        vals = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax).astype(jnp.int32)
+        v = jnp.take(vals, kk - 1, axis=ax)
+        i = jnp.take(idx, kk - 1, axis=ax)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i
+
+    return run_op("kthvalue", fn, [_t(x)])
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(_t(x)._value)
+    ax = axis % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int32)
+    for r in range(flat.shape[0]):
+        uniq, counts = np.unique(flat[r], return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[r] = best
+        idxs[r] = np.where(flat[r] == best)[0][-1]
+    out_shape = moved.shape[:-1]
+    v = vals.reshape(out_shape)
+    i = idxs.reshape(out_shape)
+    if keepdim:
+        v, i = np.expand_dims(v, ax), np.expand_dims(i, ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(i))
+
+
+def masked_fill_(x, mask, value, name=None):
+    from .manipulation import masked_fill
+
+    out = masked_fill(x, mask, value)
+    x._inplace_update(out)
+    return x
+
+
+for _name in __all__:
+    register_tensor_method(_name, globals()[_name])

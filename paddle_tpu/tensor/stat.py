@@ -1,0 +1,89 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = ["std", "var", "median", "nanmedian", "quantile", "nanquantile", "numel"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return run_op(
+        "std",
+        lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        [_t(x)],
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return run_op(
+        "var",
+        lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        [_t(x)],
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        ax = _ax(axis)
+        if ax is None:
+            flat = jnp.sort(a.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        srt = jnp.sort(a, axis=ax)
+        idx = (a.shape[ax] - 1) // 2
+        out = jnp.take(srt, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return run_op("median", fn, [_t(x)])
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return run_op(
+        "nanmedian",
+        lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim),
+        [_t(x)],
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = jnp.asarray(q)
+    return run_op(
+        "quantile",
+        lambda a: jnp.quantile(a, qq, axis=_ax(axis), keepdims=keepdim, method=interpolation),
+        [_t(x)],
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = jnp.asarray(q)
+    return run_op(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, qq, axis=_ax(axis), keepdims=keepdim, method=interpolation),
+        [_t(x)],
+    )
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size, jnp.int32))
+
+
+for _name in __all__:
+    register_tensor_method(_name, globals()[_name])

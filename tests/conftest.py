@@ -1,0 +1,30 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the analog of the reference's
+spawn-on-localhost fake cluster, test/legacy_test/test_parallel_dygraph_dataparallel.py:30)
+so multi-chip sharding logic is exercised without TPU hardware. These env vars
+must be set before jax is imported anywhere in the process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Numeric-parity oracle tests need full-precision GEMMs (the TPU bf16-pass
+# default is a perf choice, not a correctness one) — same stance as the
+# reference's FLAGS_cudnn_deterministic test mode.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+# The environment's axon sitecustomize force-sets jax_platforms="axon,cpu"
+# programmatically (overriding the env var). Re-override to cpu BEFORE any
+# backend initializes so tests never touch the TPU tunnel.
+jax.config.update("jax_platforms", "cpu")

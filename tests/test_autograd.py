@@ -1,0 +1,256 @@
+"""Eager autograd tests — tape backward, grad accumulation, hooks, PyLayer
+(reference: test/legacy_test/test_imperative_* and test/legacy_test/test_pylayer_op.py),
+with finite-difference/NumPy oracles."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def tensor(a, sg=False):
+    t = paddle.to_tensor(np.asarray(a, np.float32))
+    t.stop_gradient = sg
+    return t
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = tensor([2.0, 3.0])
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0], rtol=1e-6)
+
+    def test_branching_graph(self):
+        x = tensor([1.0, 2.0])
+        a = x * 2
+        b = x * 3
+        y = (a * b).sum()  # y = 6x^2, dy/dx = 12x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0, 24.0], rtol=1e-6)
+
+    def test_matmul_grad(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(4, 2).astype(np.float32)
+        ta, tb = tensor(a), tensor(b)
+        loss = paddle.matmul(ta, tb).sum()
+        loss.backward()
+        np.testing.assert_allclose(ta.grad.numpy(), np.ones((3, 2)) @ b.T, rtol=1e-4)
+        np.testing.assert_allclose(tb.grad.numpy(), a.T @ np.ones((3, 2)), rtol=1e-4)
+
+    def test_grad_accumulation(self):
+        x = tensor([1.0, 1.0])
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient(self):
+        x = tensor([1.0])
+        y = tensor([2.0], sg=True)
+        (x * y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = tensor([3.0])
+        d = (x * 2).detach()
+        assert d.stop_gradient
+        z = x * d
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_no_grad(self):
+        x = tensor([1.0])
+        with paddle.no_grad():
+            y = x * 5
+        assert y._grad_node is None
+        assert y.stop_gradient
+
+    def test_multi_output_op(self):
+        x = tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        a, b = paddle.split(x, 2, axis=0)
+        (a.sum() * 2 + b.sum() * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[2, 2, 2], [3, 3, 3]])
+
+    def test_backward_nonscalar_raises(self):
+        x = tensor([1.0, 2.0])
+        with pytest.raises(RuntimeError):
+            (x * 2).backward()
+
+    def test_backward_with_grad_tensor(self):
+        x = tensor([1.0, 2.0])
+        y = x * x
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_hook(self):
+        x = tensor([1.0])
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy()[0]))
+        (x * 4).sum().backward()
+        assert seen == [4.0]
+
+    def test_hook_modifies_grad(self):
+        x = tensor([1.0])
+        x.register_hook(lambda g: g * 10)
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_nonlinear_vs_fd(self):
+        rng = np.random.RandomState(1)
+        a = rng.rand(5).astype(np.float32) + 0.5
+
+        def f(v):
+            return float(np.sum(np.tanh(v) * np.exp(v * 0.5)))
+
+        x = tensor(a)
+        (paddle.tanh(x) * paddle.exp(x * 0.5)).sum().backward()
+        eps = 1e-3
+        for i in range(5):
+            ap, am = a.copy(), a.copy()
+            ap[i] += eps
+            am[i] -= eps
+            fd = (f(ap) - f(am)) / (2 * eps)
+            np.testing.assert_allclose(x.grad.numpy()[i], fd, rtol=1e-2)
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = tensor([2.0])
+        y = x * x * x
+        (gx,) = paddle.grad(y, [x])
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_unused(self):
+        x = tensor([1.0])
+        z = tensor([1.0])
+        y = x * 2
+        gx, gz = paddle.grad(y.sum(), [x, z], allow_unused=True)
+        assert gz is None
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = tensor([3.0])
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_custom_grad_override(self):
+        class FakeGrad(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return paddle.exp(x)
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 0 + 7
+
+        x = tensor([0.0])
+        FakeGrad.apply(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_multi_io(self):
+        class MulAdd(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, ga, gb):
+                a, b = ctx.saved_tensor()
+                return ga * b + gb, ga * a + gb
+
+        a, b = tensor([2.0]), tensor([5.0])
+        p, s = MulAdd.apply(a, b)
+        (p.sum() + s.sum()).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [6.0])
+        np.testing.assert_allclose(b.grad.numpy(), [3.0])
+
+
+class TestFunctionalAD:
+    def test_vjp(self):
+        x = tensor([1.0, 2.0])
+        out, g = paddle.autograd.vjp(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+    def test_jvp(self):
+        x = tensor([1.0, 2.0])
+        out, t = paddle.autograd.jvp(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(t.numpy(), 6.0, rtol=1e-6)
+
+    def test_jacobian(self):
+        x = tensor([1.0, 2.0])
+        j = paddle.autograd.jacobian(lambda v: v * v, x)
+        np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0]))
+
+    def test_hessian(self):
+        x = tensor([1.0, 2.0])
+        h = paddle.autograd.hessian(lambda v: (v * v * v).sum(), x)
+        np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]), atol=1e-5)
+
+
+class TestSaveLoad:
+    def test_save_load_roundtrip(self, tmp_path):
+        obj = {"w": paddle.randn([3, 3]), "step": 7, "nested": {"b": paddle.ones([2])}}
+        p = str(tmp_path / "ckpt.pdparams")
+        paddle.save(obj, p)
+        back = paddle.load(p)
+        np.testing.assert_array_equal(back["w"].numpy(), obj["w"].numpy())
+        assert back["step"] == 7
+        np.testing.assert_array_equal(back["nested"]["b"].numpy(), [1, 1])
+
+
+class TestReviewRegressions:
+    """Regressions from code review: in-place tape cycles, intermediate grads."""
+
+    def test_setitem_on_intermediate_keeps_grad(self):
+        x = tensor([1.0, 2.0, 3.0])
+        y = x * 2
+        y[0] = 5.0  # in-place on non-leaf must keep the graph acyclic
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+    def test_setitem_on_leaf_requiring_grad_raises(self):
+        x = tensor([1.0, 2.0, 3.0])
+        with pytest.raises(RuntimeError):
+            x[0] = 5.0
+
+    def test_grad_wrt_intermediate(self):
+        a = tensor([2.0])
+        h = a * 3
+        y = h * h
+        gh = paddle.grad(y.sum(), h)
+        np.testing.assert_allclose(gh.numpy(), [12.0])
+
+    def test_hook_on_intermediate_fires_and_modifies(self):
+        a = tensor([1.0])
+        h = a * 2
+        h.register_hook(lambda g: g * 10)
+        (h * 3).sum().backward()
+        # dh = 3, hook -> 30, da = 30 * 2 = 60
+        np.testing.assert_allclose(a.grad.numpy(), [60.0])
+
+    def test_retain_grads(self):
+        a = tensor([1.0])
+        h = a * 2
+        h.retain_grads()
+        (h * 3).sum().backward()
+        np.testing.assert_allclose(h.grad.numpy(), [3.0])

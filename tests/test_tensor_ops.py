@@ -1,0 +1,314 @@
+"""Op unit tests vs NumPy oracle — the OpTest pattern
+(reference: test/legacy_test/op_test.py:418)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def check(t, ref, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(t.numpy(), np.float64), ref, rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == np.float32
+        np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_array_equal(paddle.full([2], 7, "int32").numpy(), [7, 7])
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        x = paddle.ones([3, 3])
+        np.testing.assert_array_equal(paddle.tril(x).numpy(), np.tril(np.ones((3, 3))))
+        np.testing.assert_array_equal(paddle.triu(x).numpy(), np.triu(np.ones((3, 3))))
+
+    def test_like_variants(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.ones_like(x).numpy().sum() == 6
+        assert paddle.full_like(x, 3).numpy().sum() == 18
+
+
+class TestMath:
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(0)
+
+    def test_binary_ops(self):
+        a = self.rng.rand(3, 4).astype(np.float32)
+        b = self.rng.rand(3, 4).astype(np.float32) + 0.5
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        check(paddle.add(ta, tb), a + b)
+        check(paddle.subtract(ta, tb), a - b)
+        check(paddle.multiply(ta, tb), a * b)
+        check(paddle.divide(ta, tb), a / b, rtol=1e-5)
+        check(paddle.maximum(ta, tb), np.maximum(a, b))
+        check(paddle.pow(ta, 2.0), a**2, rtol=1e-5)
+
+    def test_operators(self):
+        a = self.rng.rand(3, 4).astype(np.float32)
+        b = self.rng.rand(3, 4).astype(np.float32) + 0.5
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        check(ta + tb, a + b)
+        check(ta - tb, a - b)
+        check(ta * 2, a * 2)
+        check(2 / tb, 2 / b, rtol=1e-5)
+        check(-ta, -a)
+        assert bool((ta > tb).numpy()[0, 0]) == bool(a[0, 0] > b[0, 0])
+
+    def test_unary_ops(self):
+        a = self.rng.rand(4, 5).astype(np.float32) + 0.1
+        t = paddle.to_tensor(a)
+        check(paddle.exp(t), np.exp(a), rtol=1e-4)
+        check(paddle.log(t), np.log(a), rtol=1e-3, atol=1e-4)
+        check(paddle.sqrt(t), np.sqrt(a), rtol=1e-5)
+        check(paddle.tanh(t), np.tanh(a), rtol=1e-4, atol=1e-5)
+        check(paddle.sigmoid(t), 1 / (1 + np.exp(-a)), rtol=1e-4)
+        check(paddle.abs(paddle.to_tensor(-a)), a)
+        check(paddle.rsqrt(t), 1 / np.sqrt(a), rtol=1e-4)
+
+    def test_reductions(self):
+        a = self.rng.rand(3, 4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(paddle.sum(t), a.sum(), rtol=1e-4)
+        check(paddle.sum(t, axis=1), a.sum(1), rtol=1e-4)
+        check(paddle.mean(t, axis=[0, 2]), a.mean((0, 2)), rtol=1e-4)
+        check(paddle.max(t, axis=-1, keepdim=True), a.max(-1, keepdims=True))
+        check(paddle.min(t), a.min())
+        check(paddle.prod(t, axis=0), a.prod(0), rtol=1e-4)
+
+    def test_method_chaining(self):
+        a = self.rng.rand(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(t.exp().log(), a, rtol=1e-3, atol=1e-4)
+        check(t.sum(axis=0), a.sum(0), rtol=1e-5)
+        assert t.reshape([4, 3]).shape == [4, 3]
+
+    def test_cumsum_clip(self):
+        a = self.rng.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(paddle.cumsum(t, axis=1), np.cumsum(a, 1), rtol=1e-5)
+        check(paddle.clip(t, -0.5, 0.5), np.clip(a, -0.5, 0.5))
+
+    def test_scale(self):
+        a = self.rng.rand(3).astype(np.float32)
+        check(paddle.scale(paddle.to_tensor(a), 2.0, 1.0), a * 2 + 1, rtol=1e-6)
+
+
+class TestManipulation:
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(1)
+
+    def test_reshape_transpose(self):
+        a = self.rng.rand(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(paddle.reshape(t, [6, 4]), a.reshape(6, 4))
+        check(paddle.transpose(t, [2, 0, 1]), a.transpose(2, 0, 1))
+        check(paddle.flatten(t, 1, 2), a.reshape(2, 12))
+
+    def test_squeeze_unsqueeze(self):
+        a = self.rng.rand(2, 1, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert paddle.squeeze(t, 1).shape == [2, 3]
+        assert paddle.unsqueeze(t, 0).shape == [1, 2, 1, 3]
+        assert paddle.unsqueeze(t, [0, 4]).shape == [1, 2, 1, 3, 1]
+
+    def test_concat_stack_split(self):
+        a = self.rng.rand(2, 3).astype(np.float32)
+        b = self.rng.rand(2, 3).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        check(paddle.concat([ta, tb], axis=0), np.concatenate([a, b], 0))
+        check(paddle.stack([ta, tb], axis=1), np.stack([a, b], 1))
+        parts = paddle.split(paddle.concat([ta, tb], axis=0), 2, axis=0)
+        assert len(parts) == 2
+        check(parts[0], a)
+        parts = paddle.split(ta, [1, 2], axis=1)
+        check(parts[1], a[:, 1:])
+
+    def test_gather_scatter(self):
+        a = self.rng.rand(5, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        idx = paddle.to_tensor([0, 2], dtype="int32")
+        check(paddle.gather(t, idx, axis=0), a[[0, 2]])
+        upd = np.ones((2, 3), np.float32)
+        out = paddle.scatter(t, idx, paddle.to_tensor(upd))
+        ref = a.copy()
+        ref[[0, 2]] = 1
+        check(out, ref)
+
+    def test_indexing(self):
+        a = self.rng.rand(4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(t[1], a[1])
+        check(t[1:3, ::2], a[1:3, ::2])
+        check(t[:, -1], a[:, -1])
+        t2 = paddle.to_tensor(a.copy())
+        t2[0] = 0.0
+        ref = a.copy()
+        ref[0] = 0
+        check(t2, ref)
+
+    def test_tile_expand_pad(self):
+        a = self.rng.rand(2, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(paddle.tile(t, [2, 1]), np.tile(a, (2, 1)))
+        check(paddle.expand(paddle.to_tensor(a[:1]), [4, 3]), np.broadcast_to(a[:1], (4, 3)))
+        check(paddle.pad(t, [1, 1], value=0.0), np.pad(a, [(0, 0), (1, 1)]))
+
+    def test_take_put_along_axis(self):
+        a = self.rng.rand(3, 4).astype(np.float32)
+        idx = np.argsort(a, axis=1).astype(np.int32)
+        t, ti = paddle.to_tensor(a), paddle.to_tensor(idx)
+        check(paddle.take_along_axis(t, ti, 1), np.take_along_axis(a, idx, 1))
+
+    def test_masked_select_where(self):
+        a = self.rng.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        m = t > 0
+        check(paddle.masked_select(t, m), a[a > 0])
+        check(paddle.where(m, t, paddle.zeros_like(t)), np.where(a > 0, a, 0))
+
+    def test_flip_roll(self):
+        a = self.rng.rand(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(paddle.flip(t, [0]), a[::-1])
+        check(paddle.roll(t, 1, axis=0), np.roll(a, 1, 0))
+
+
+class TestLinalg:
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(2)
+
+    def test_matmul(self):
+        a = self.rng.rand(3, 4).astype(np.float32)
+        b = self.rng.rand(4, 5).astype(np.float32)
+        check(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)), a @ b, rtol=1e-4)
+        check(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T), transpose_y=True),
+            a @ b,
+            rtol=1e-4,
+        )
+
+    def test_batched_matmul(self):
+        a = self.rng.rand(2, 3, 4).astype(np.float32)
+        b = self.rng.rand(2, 4, 5).astype(np.float32)
+        check(paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b)), a @ b, rtol=1e-4)
+
+    def test_norm_det_inv(self):
+        a = self.rng.rand(3, 3).astype(np.float32) + np.eye(3, dtype=np.float32) * 3
+        t = paddle.to_tensor(a)
+        check(paddle.linalg.norm(t), np.linalg.norm(a), rtol=1e-4)
+        check(paddle.linalg.det(t), np.linalg.det(a), rtol=1e-4)
+        check(paddle.linalg.inv(t), np.linalg.inv(a), rtol=1e-3, atol=1e-5)
+
+    def test_einsum(self):
+        a = self.rng.rand(3, 4).astype(np.float32)
+        b = self.rng.rand(4, 5).astype(np.float32)
+        check(paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)), a @ b, rtol=1e-4)
+
+
+class TestSearchLogic:
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(3)
+
+    def test_argmax_topk_sort(self):
+        a = self.rng.rand(3, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), a.argmax(1))
+        vals, idx = paddle.topk(t, 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), np.sort(a, 1)[:, ::-1][:, :2], rtol=1e-6)
+        check(paddle.sort(t, axis=1), np.sort(a, 1))
+
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal(paddle.equal(ta, tb).numpy(), a == b)
+        np.testing.assert_array_equal(paddle.less_than(ta, tb).numpy(), a < b)
+        assert bool(paddle.allclose(ta, ta).numpy())
+        assert not bool(paddle.equal_all(ta, tb).numpy())
+
+    def test_nonzero(self):
+        a = np.array([[0, 1], [2, 0]], np.float32)
+        out = paddle.nonzero(paddle.to_tensor(a))
+        np.testing.assert_array_equal(out.numpy(), np.stack(np.nonzero(a), 1))
+
+
+class TestStat:
+    def test_std_var_median(self):
+        rng = np.random.RandomState(4)
+        a = rng.rand(4, 6).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check(paddle.std(t), a.std(ddof=1), rtol=1e-4)
+        check(paddle.var(t, axis=1), a.var(1, ddof=1), rtol=1e-4)
+        check(paddle.median(t), np.median(a), rtol=1e-5)
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4])
+        paddle.seed(42)
+        b = paddle.randn([4, 4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([100], min=0.0, max=1.0)
+        assert u.numpy().min() >= 0 and u.numpy().max() <= 1
+        r = paddle.randint(0, 10, [50])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(10))
+
+
+class TestCast:
+    def test_astype(self):
+        t = paddle.to_tensor([1.7, 2.3])
+        assert t.astype("int32").numpy().tolist() == [1, 2]
+        assert t.astype("float16").dtype == np.float16
+        assert paddle.to_tensor([1, 2]).dtype in (np.int32, np.int64)
+
+
+class TestReviewRegressions:
+    def test_split_non_divisible_raises(self):
+        with pytest.raises(ValueError):
+            paddle.split(paddle.arange(7), 3)
+
+    def test_chunk_uneven(self):
+        parts = paddle.chunk(paddle.arange(7), 3)
+        assert [p.shape[0] for p in parts] == [3, 3, 1]
+        np.testing.assert_array_equal(parts[2].numpy(), [6])
+
+    def test_bitwise_operators(self):
+        a = paddle.to_tensor([3], dtype="int32")
+        b = paddle.to_tensor([5], dtype="int32")
+        assert (a & b).numpy().tolist() == [1]
+        assert (a | b).numpy().tolist() == [7]
+        assert (a ^ b).numpy().tolist() == [6]
+        assert (~a).numpy().tolist() == [-4]
+        t = paddle.to_tensor([True, False])
+        np.testing.assert_array_equal((~t).numpy(), [False, True])
+
+    def test_cummax_cummin(self):
+        a = np.array([[1.0, 3.0, 2.0], [4.0, 0.0, 5.0]], np.float32)
+        vals, idx = paddle.cummax(paddle.to_tensor(a), axis=1)
+        np.testing.assert_array_equal(vals.numpy(), np.maximum.accumulate(a, 1))
+        np.testing.assert_array_equal(idx.numpy(), [[0, 1, 1], [0, 0, 2]])
+        vals, idx = paddle.cummin(paddle.to_tensor(a), axis=1)
+        np.testing.assert_array_equal(vals.numpy(), np.minimum.accumulate(a, 1))
+
+    def test_argmax_dtype_honored(self):
+        x = paddle.to_tensor([[1.0, 5.0]])
+        assert paddle.argmax(x, axis=1, dtype="int32").dtype == np.int32
