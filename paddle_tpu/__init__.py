@@ -95,3 +95,7 @@ class TPUPlace:
 CUDAPlace = TPUPlace  # scripts that name CUDAPlace get the accelerator
 
 # subpackages added as they are built (M2+)
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import amp  # noqa: E402
+from .nn.layer.layers import ParamAttr  # noqa: E402

@@ -1,0 +1,225 @@
+"""AMP: auto_cast, GradScaler, decorate (reference: python/paddle/amp/).
+
+TPU stance: bf16 is the native mixed-precision dtype (no loss scaling needed —
+bf16 has f32's exponent range), so GradScaler defaults to a functional no-op
+that keeps the reference API (scale/unscale/step/update, dynamic scaling
+still implemented for fp16 parity). auto_cast installs a run_op input
+interceptor — the analog of the AMP branch in every generated ad_func
+(paddle/fluid/imperative/amp_auto_cast.cc).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor, no_grad, set_op_input_interceptor
+from .amp_lists import BLACK_LIST, WHITE_LIST
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler", "is_float16_supported", "is_bfloat16_supported"]
+
+_amp_state = {"enable": False, "dtype": "bfloat16", "level": "O1",
+              "custom_white_list": set(), "custom_black_list": set()}
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def _interceptor(op_name, values):
+    if not _amp_state["enable"]:
+        return values
+    target = jnp.bfloat16 if _amp_state["dtype"] == "bfloat16" else jnp.float16
+    white = (WHITE_LIST | _amp_state["custom_white_list"]) - _amp_state["custom_black_list"]
+    black = BLACK_LIST | _amp_state["custom_black_list"]
+    level = _amp_state["level"]
+
+    def cast_to(v, d):
+        if hasattr(v, "dtype") and v.dtype in (jnp.float32, jnp.bfloat16, jnp.float16) and v.dtype != d:
+            return v.astype(d)
+        return v
+
+    if op_name in black:
+        return [cast_to(v, jnp.float32) for v in values]
+    if level == "O2":
+        # cast everything float to target except black list
+        return [cast_to(v, target) for v in values]
+    if op_name in white:
+        return [cast_to(v, target) for v in values]
+    return values
+
+
+class auto_cast(contextlib.ContextDecorator):
+    """paddle.amp.auto_cast (reference: python/paddle/amp/auto_cast.py:1018)."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16", use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtype
+        self.white = set(custom_white_list or [])
+        self.black = set(custom_black_list or [])
+
+    def __enter__(self):
+        self._saved = dict(_amp_state)
+        _amp_state.update(
+            enable=self.enable, dtype=self.dtype, level=self.level,
+            custom_white_list=self.white, custom_black_list=self.black,
+        )
+        set_op_input_interceptor(_interceptor if self.enable else None)
+        return self
+
+    def __exit__(self, *exc):
+        _amp_state.update(self._saved)
+        set_op_input_interceptor(_interceptor if _amp_state["enable"] else None)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """paddle.amp.decorate (reference: auto_cast.py:1103) — O2 casts model
+    params to the AMP dtype, keeping norm layers in f32."""
+    from ..nn.layer.norm import LayerNorm, _BatchNormBase
+
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        target = dtype
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, (_BatchNormBase, LayerNorm)):
+                    continue
+                if excluded_layers and isinstance(layer, tuple(excluded_layers)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and p.dtype == np.float32:
+                        p._value = p._value.astype(
+                            jnp.bfloat16 if target == "bfloat16" else jnp.float16
+                        )
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for o in opt_list:
+        o._multi_precision = True
+    return (models if single else model_list), (optimizers if opt_single else opt_list)
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    """reference: python/paddle/amp/grad_scaler.py:657. With bf16 (TPU default)
+    scaling is the identity; with fp16 the full dynamic-loss-scale state
+    machine runs (init_loss_scaling, incr/decr ratios, skip-on-inf)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable or self._scale == 1.0:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list or []:
+            params = p["params"] if isinstance(p, dict) else [p]
+            for q in params:
+                if q.grad is not None:
+                    gv = q.grad._value
+                    if self._scale != 1.0:
+                        gv = gv * inv
+                        q.grad._value = gv
+                    if not bool(jnp.all(jnp.isfinite(gv))):
+                        found_inf = True
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if self._found_inf:
+            self._update_on_inf()
+            return
+        optimizer.step()
+        self._update_on_good()
+
+    def update(self):
+        # paddle's separate update(); state already advanced in step()
+        return
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def _update_on_good(self):
+        if not self._dynamic:
+            return
+        self._good_steps += 1
+        self._bad_steps = 0
+        if self._good_steps >= self._incr_every:
+            self._scale *= self._incr_ratio
+            self._good_steps = 0
+
+    def _update_on_inf(self):
+        if not self._dynamic:
+            return
+        self._bad_steps += 1
+        self._good_steps = 0
+        if self._bad_steps >= self._decr_every:
+            self._scale = max(self._scale * self._decr_ratio, 1.0)
+            self._bad_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+            "use_dynamic_loss_scaling": self._dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)

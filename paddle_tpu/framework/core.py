@@ -545,13 +545,15 @@ class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase);
     stop_gradient defaults to False and it carries a trainable flag."""
 
-    __slots__ = ("trainable", "optimize_attr", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer", "need_clip")
 
     def __init__(self, value, trainable: bool = True, name: str | None = None):
         super().__init__(value, stop_gradient=not trainable, name=name)
         self.trainable = trainable
         self.optimize_attr = {"learning_rate": 1.0}
         self.is_distributed = False
+        self.regularizer = None
+        self.need_clip = True
 
 
 def _normalize_index(idx):

@@ -1,0 +1,10 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/)."""
+
+from . import functional, initializer
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import layers as _layers_mod
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+
+__all__ = ["Layer", "ParamAttr", "functional", "initializer",
+           "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]

@@ -1,0 +1,22 @@
+"""nn.functional namespace (reference: python/paddle/nn/functional/)."""
+
+from . import activation, common, conv, loss, norm, pooling
+from . import flash_attention as _flash_attention_mod
+
+__all__ = (
+    list(activation.__all__)
+    + list(common.__all__)
+    + list(conv.__all__)
+    + list(pooling.__all__)
+    + list(norm.__all__)
+    + list(loss.__all__)
+    + list(_flash_attention_mod.__all__)
+)
+
+from .activation import *  # noqa: F401,F403,E402
+from .common import *  # noqa: F401,F403,E402
+from .conv import *  # noqa: F401,F403,E402
+from .flash_attention import *  # noqa: F401,F403,E402
+from .loss import *  # noqa: F401,F403,E402
+from .norm import *  # noqa: F401,F403,E402
+from .pooling import *  # noqa: F401,F403,E402

@@ -1,0 +1,243 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All lower to jax.nn / jnp — XLA fuses these into neighbouring matmuls on TPU,
+which is exactly what the reference's fused_bias_act epilogue kernels do by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "relu",
+    "relu6",
+    "relu_",
+    "gelu",
+    "leaky_relu",
+    "elu",
+    "selu",
+    "celu",
+    "silu",
+    "swish",
+    "mish",
+    "hardswish",
+    "hardsigmoid",
+    "hardtanh",
+    "hardshrink",
+    "softshrink",
+    "tanhshrink",
+    "softplus",
+    "softsign",
+    "prelu",
+    "softmax",
+    "log_softmax",
+    "sigmoid",
+    "log_sigmoid",
+    "tanh",
+    "glu",
+    "gumbel_softmax",
+    "maxout",
+    "thresholded_relu",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def relu(x, name=None):
+    return run_op("relu", jax.nn.relu, [_t(x)])
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    if isinstance(x, Tensor):
+        return x._inplace_update(out)
+    return out
+
+
+def relu6(x, name=None):
+    return run_op("relu6", jax.nn.relu6, [_t(x)])
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), [_t(x)])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op(
+        "leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), [_t(x)]
+    )
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op("elu", lambda a: jax.nn.elu(a, alpha), [_t(x)])
+
+
+def selu(
+    x,
+    scale=1.0507009873554805,
+    alpha=1.6732632423543772,
+    name=None,
+):
+    return run_op(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+        [_t(x)],
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op("celu", lambda a: jax.nn.celu(a, alpha), [_t(x)])
+
+
+def silu(x, name=None):
+    return run_op("silu", jax.nn.silu, [_t(x)])
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return run_op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), [_t(x)])
+
+
+def hardswish(x, name=None):
+    return run_op("hardswish", jax.nn.hard_swish, [_t(x)])
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return run_op(
+        "hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), [_t(x)]
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return run_op("hardtanh", lambda a: jnp.clip(a, min, max), [_t(x)])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, jnp.zeros((), a.dtype)),
+        [_t(x)],
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op(
+        "softshrink",
+        lambda a: jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        ).astype(a.dtype),
+        [_t(x)],
+    )
+
+
+def tanhshrink(x, name=None):
+    return run_op("tanhshrink", lambda a: a - jnp.tanh(a), [_t(x)])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return run_op(
+        "softplus",
+        lambda a: jnp.where(
+            beta * a > threshold, a, (1.0 / beta) * jax.nn.softplus(beta * a)
+        ),
+        [_t(x)],
+    )
+
+
+def softsign(x, name=None):
+    return run_op("softsign", jax.nn.soft_sign, [_t(x)])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            slope = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            slope = w.reshape(shape)
+        return jnp.where(a > 0, a, slope * a)
+
+    return run_op("prelu", fn, [_t(x), _t(weight)])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...framework import dtype as dtype_mod
+
+            a = a.astype(jnp.dtype(dtype_mod.convert_dtype(dtype)))
+        return jax.nn.softmax(a, axis=axis)
+
+    return run_op("softmax", fn, [_t(x)])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...framework import dtype as dtype_mod
+
+            a = a.astype(jnp.dtype(dtype_mod.convert_dtype(dtype)))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return run_op("log_softmax", fn, [_t(x)])
+
+
+def sigmoid(x, name=None):
+    return run_op("sigmoid", jax.nn.sigmoid, [_t(x)])
+
+
+def log_sigmoid(x, name=None):
+    return run_op("log_sigmoid", jax.nn.log_sigmoid, [_t(x)])
+
+
+def tanh(x, name=None):
+    return run_op("tanh", jnp.tanh, [_t(x)])
+
+
+def glu(x, axis=-1, name=None):
+    return run_op("glu", lambda a: jax.nn.glu(a, axis=axis), [_t(x)])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as rnd
+
+    key = rnd.next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            # straight-through: one-hot forward, soft gradient
+            oh = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+            return oh + y - jax.lax.stop_gradient(y)
+        return y
+
+    return run_op("gumbel_softmax", fn, [_t(x)])
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        shp = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(shp), axis=ax + 1)
+
+    return run_op("maxout", fn, [_t(x)])
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return run_op(
+        "thresholded_relu",
+        lambda a: jnp.where(a > threshold, a, jnp.asarray(value, a.dtype)),
+        [_t(x)],
+    )

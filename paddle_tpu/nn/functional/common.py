@@ -1,0 +1,316 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, etc.
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as rnd
+from ...framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "linear",
+    "dropout",
+    "dropout2d",
+    "dropout3d",
+    "alpha_dropout",
+    "embedding",
+    "one_hot",
+    "label_smooth",
+    "pad",
+    "interpolate",
+    "upsample",
+    "unfold",
+    "fold",
+    "cosine_similarity",
+    "pixel_shuffle",
+    "pixel_unshuffle",
+    "channel_shuffle",
+    "bilinear",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Reference stores weight [in, out]
+    (python/paddle/nn/functional/common.py linear); bf16/f16 accumulate in f32
+    on the MXU via preferred_element_type."""
+
+    if bias is None:
+        def fn(a, w):
+            acc = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+            return jnp.matmul(a, w, preferred_element_type=acc).astype(
+                jnp.promote_types(a.dtype, w.dtype)
+            )
+
+        return run_op("linear", fn, [_t(x), _t(weight)])
+
+    def fnb(a, w, b):
+        acc = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+        out = jnp.matmul(a, w, preferred_element_type=acc).astype(
+            jnp.promote_types(a.dtype, w.dtype)
+        )
+        return out + b
+
+    return run_op("linear", fnb, [_t(x), _t(weight), _t(bias)])
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    xx = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return run_op("dropout_scale", lambda a: a * (1.0 - p), [xx])
+        return xx
+    if p == 1.0:
+        return run_op("dropout_all", lambda a: jnp.zeros_like(a), [xx])
+    key = rnd.next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype)).astype(a.dtype)
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+
+    return run_op("dropout", fn, [xx])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    xx = _t(x)
+    if not training or p == 0.0:
+        return xx
+    key = rnd.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return run_op("alpha_dropout", fn, [xx])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: python/paddle/nn/functional/input.py embedding. padding_idx
+    rows contribute zero gradient (masked lookup)."""
+
+    def fn(ids, w):
+        ids = ids.astype(jnp.int32)
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None].astype(out.dtype)
+            out = out * mask
+        return out
+
+    return run_op("embedding", fn, [_t(x), _t(weight)])
+
+
+def one_hot(x, num_classes, name=None):
+    return run_op(
+        "one_hot",
+        lambda a: jax.nn.one_hot(a.astype(jnp.int32), int(num_classes), dtype=jnp.float32),
+        [_t(x)],
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is None:
+        def fn(l):
+            k = l.shape[-1]
+            return (1 - epsilon) * l + epsilon / k
+
+        return run_op("label_smooth", fn, [_t(label)])
+
+    def fnp(l, pd):
+        return (1 - epsilon) * l + epsilon * pd
+
+    return run_op("label_smooth", fnp, [_t(label), _t(prior_dist)])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ...tensor.manipulation import pad as _pad
+
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    """reference: python/paddle/nn/functional/common.py interpolate — via
+    jax.image.resize (nearest / bilinear / bicubic / trilinear / area)."""
+    xx = _t(x)
+    nd = xx.ndim
+    channels_last = not data_format.startswith("NC")
+    spatial = list(range(2, nd)) if not channels_last else list(range(1, nd - 1))
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+        out_sizes = [int(xx.shape[ax] * f) for ax, f in zip(spatial, sf)]
+
+    jmode = {
+        "nearest": "nearest",
+        "bilinear": "bilinear",
+        "bicubic": "bicubic",
+        "trilinear": "trilinear",
+        "linear": "linear",
+        "area": "linear",
+    }[mode]
+
+    def fn(a):
+        out_shape = list(a.shape)
+        for ax, s in zip(spatial, out_sizes):
+            out_shape[ax] = s
+        return jax.image.resize(a, tuple(out_shape), method=jmode).astype(a.dtype)
+
+    return run_op("interpolate", fn, [xx])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: unfold op). Output [N, C*kh*kw, L]."""
+    xx = _t(x)
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        oh = (a.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (a.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        return patches.reshape(n, c * kh * kw, oh * ow)
+
+    return run_op("unfold", fn, [xx])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    xx = _t(x)
+    oh, ow = output_sizes
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    p = paddings if isinstance(paddings, int) else paddings[0]
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        ph = oh + 2 * p
+        pw = ow + 2 * p
+        nh = (ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (pw - (dw * (kw - 1) + 1)) // sw + 1
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        a5 = a.reshape(n, c, kh, kw, nh, nw)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + nh * sh:sh, wj:wj + nw * sw:sw].add(a5[:, :, i, j])
+        return out[:, :, p:p + oh, p:p + ow]
+
+    return run_op("fold", fn, [xx])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return run_op("cosine_similarity", fn, [_t(x1), _t(x2)])
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        a = a.reshape(n, oc, r, r, h, w)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(n, oc, h * r, w * r)
+
+    return run_op("pixel_shuffle", fn, [_t(x)])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return run_op("pixel_unshuffle", fn, [_t(x)])
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, g, c // g, h, w)
+        return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return run_op("channel_shuffle", fn, [_t(x)])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    ins = [_t(x1), _t(x2), _t(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        ins.append(_t(bias))
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return run_op("bilinear", fn, ins)

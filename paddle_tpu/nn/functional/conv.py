@@ -1,0 +1,181 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py).
+
+All lower to lax.conv_general_dilated, which XLA maps onto the MXU —
+the entire phi conv kernel zoo (gpudnn, cutlass conv2d fusions) collapses
+into this one primitive plus XLA epilogue fusion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "conv1d",
+    "conv2d",
+    "conv3d",
+    "conv1d_transpose",
+    "conv2d_transpose",
+    "conv3d_transpose",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n):
+    """Paddle padding spec -> lax padding list or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[pt,pb],[pl,pr]] including batch/channel
+    if len(padding) == n + 2:
+        return [(int(p[0]), int(p[1])) for p in padding[2:]]
+    raise ValueError(f"unsupported padding spec {padding!r}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channels_last = not data_format.startswith("NC")
+    if channels_last:
+        spec_map = {1: ("NWC", "OIW", "NWC"), 2: ("NHWC", "OIHW", "NHWC"), 3: ("NDHWC", "OIDHW", "NDHWC")}
+    else:
+        spec_map = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"), 3: ("NCDHW", "OIDHW", "NCDHW")}
+    dn = spec_map[n]
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    ins = [_t(x), _t(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        ins.append(_t(bias))
+
+    def fn(a, w, *rest):
+        acc = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+        out = jax.lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dil,
+            dimension_numbers=dn,
+            feature_group_count=int(groups),
+            preferred_element_type=acc,
+        ).astype(a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channels_last else -1] = b.size
+            out = out + b.reshape(shape)
+        return out
+
+    return run_op(f"conv{n}d", fn, ins)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format, output_size):
+    channels_last = not data_format.startswith("NC")
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    ins = [_t(x), _t(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        ins.append(_t(bias))
+
+    def fn(a, w, *rest):
+        # weight layout is [in_c, out_c/groups, *k] (paddle transpose-conv
+        # convention); use gradient-based transpose conv:
+        # conv_transpose = lhs-dilated conv with flipped kernel
+        if channels_last:
+            a_ncx = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ncx = a
+        in_c = a_ncx.shape[1]
+        kdims = w.shape[2:]
+        if isinstance(pad, str):
+            if pad == "SAME":
+                pads = [((k - 1) // 2, (k - 1) // 2) for k in kdims]
+            else:
+                pads = [(0, 0)] * n
+        else:
+            pads = pad
+        # flip spatial dims, swap io: [in, out/g, *k] -> [out, in/g... ]
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        wf = jnp.swapaxes(wf, 0, 1)  # [out_c/g, in_c, *k]
+        if groups > 1:
+            # regroup: full weight [in_c, out_c/g, *k] with groups along in_c
+            wg = w.reshape((groups, in_c // groups) + w.shape[1:])
+            outs = []
+            for g in range(groups):
+                wgf = jnp.flip(wg[g], axis=tuple(range(2, 2 + n)))
+                wgf = jnp.swapaxes(wgf, 0, 1)
+                outs.append(_transpose_one(a_ncx[:, g * (in_c // groups):(g + 1) * (in_c // groups)], wgf, strides, pads, dil, opad, n))
+            out = jnp.concatenate(outs, axis=1)
+        else:
+            out = _transpose_one(a_ncx, wf, strides, pads, dil, opad, n)
+        if rest:
+            b = rest[0]
+            out = out + b.reshape((1, b.size) + (1,) * n)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    return run_op(f"conv{n}d_transpose", fn, ins)
+
+
+def _transpose_one(a, wf, strides, pads, dil, opad, n):
+    spec = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"), 3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+    kdims = wf.shape[2:]
+    tpads = []
+    for k, s, (plo, phi), d, op in zip(kdims, strides, pads, dil, opad):
+        keff = d * (k - 1) + 1
+        tpads.append((keff - 1 - plo, keff - 1 - phi + op))
+    return jax.lax.conv_general_dilated(
+        a,
+        wf,
+        window_strides=(1,) * n,
+        padding=tpads,
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=spec,
+    )
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,359 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "mse_loss",
+    "l1_loss",
+    "nll_loss",
+    "binary_cross_entropy",
+    "binary_cross_entropy_with_logits",
+    "smooth_l1_loss",
+    "kl_div",
+    "margin_ranking_loss",
+    "cosine_embedding_loss",
+    "triplet_margin_loss",
+    "hinge_embedding_loss",
+    "square_error_cost",
+    "log_loss",
+    "ctc_loss",
+    "sigmoid_focal_loss",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(
+    input,  # noqa: A002
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy — the
+    sparse path computes log-softmax + one gather; on TPU this fuses into the
+    final projection matmul (the reference needs ParallelCrossEntropy-style
+    fused kernels for the same effect)."""
+    ins = [_t(input), _t(label)]
+    has_w = weight is not None
+    if has_w:
+        ins.append(_t(weight))
+
+    def fn(logits, lab, *rest):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30, None))
+        if soft_label:
+            tgt = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            if has_w:
+                w = rest[0].astype(jnp.float32)
+                loss = loss * jnp.sum(tgt * w, axis=axis)
+            return _reduce(loss, reduction)
+        ids = lab.astype(jnp.int32)
+        squeeze_last = ids.ndim == logits.ndim and ids.shape[axis] == 1
+        if squeeze_last:
+            ids = jnp.squeeze(ids, axis)
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis
+        ).squeeze(axis)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            smooth = jnp.mean(logp, axis=axis)
+            loss = -((1 - label_smoothing) * picked + label_smoothing * smooth)
+        else:
+            loss = -picked
+        if has_w:
+            w = rest[0].astype(jnp.float32)
+            sample_w = jnp.take(w, safe) * valid.astype(jnp.float32)
+            loss = loss * sample_w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(sample_w), 1e-12)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            n_valid = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / n_valid
+        return _reduce(loss, reduction)
+
+    return run_op("cross_entropy", fn, ins)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from .activation import softmax as _softmax
+
+    loss = run_op("unsqueeze_loss", lambda a: jnp.expand_dims(a, axis), [loss])
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return run_op(
+        "mse_loss",
+        lambda a, b: _reduce(jnp.square(a - b), reduction),
+        [_t(input), _t(label)],
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return run_op(
+        "l1_loss",
+        lambda a, b: _reduce(jnp.abs(a - b), reduction),
+        [_t(input), _t(label)],
+    )
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    ins = [_t(input), _t(label)]
+    has_w = weight is not None
+    if has_w:
+        ins.append(_t(weight))
+
+    def fn(logp, lab, *rest):
+        ids = lab.astype(jnp.int32)
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -picked
+        w = rest[0] if has_w else None
+        if w is not None:
+            sw = jnp.take(w, safe) * valid.astype(logp.dtype)
+            loss = loss * sw
+            if reduction == "mean":
+                return jnp.sum(jnp.where(valid, loss, 0.0)) / jnp.maximum(jnp.sum(sw), 1e-12)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    return run_op("nll_loss", fn, ins)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    ins = [_t(input), _t(label)]
+    has_w = weight is not None
+    if has_w:
+        ins.append(_t(weight))
+
+    def fn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    return run_op("bce", fn, ins)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    ins = [_t(logit), _t(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        ins.append(_t(weight))
+    if has_pw:
+        ins.append(_t(pos_weight))
+
+    def fn(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if has_w:
+            w = rest[i]
+            i += 1
+        if has_pw:
+            pw = rest[i]
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight
+        log_sig_pos = -jax.nn.softplus(-z)
+        log_sig_neg = -z - jax.nn.softplus(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig_pos + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig_pos + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return run_op("bce_with_logits", fn, ins)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return run_op("smooth_l1", fn, [_t(input), _t(label)])
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def fn(logp, tgt):
+        if log_target:
+            loss = jnp.exp(tgt) * (tgt - logp)
+        else:
+            safe_t = jnp.clip(tgt, 1e-12, None)
+            loss = tgt * (jnp.log(safe_t) - logp)
+            loss = jnp.where(tgt > 0, loss, 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return run_op("kl_div", fn, [_t(input), _t(label)])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    return run_op(
+        "margin_ranking",
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        [_t(input), _t(other), _t(label)],
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y > 0, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return run_op("cosine_embedding", fn, [_t(input1), _t(input2), _t(label)])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):  # noqa: A002
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return run_op("triplet_margin", fn, [_t(input), _t(positive), _t(negative)])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    return run_op(
+        "hinge_embedding",
+        lambda a, y: _reduce(
+            jnp.where(y > 0, a, jnp.maximum(0.0, margin - a)), reduction
+        ),
+        [_t(input), _t(label)],
+    )
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return run_op("square_error_cost", lambda a, b: jnp.square(a - b), [_t(input), _t(label)])
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return run_op(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        [_t(input), _t(label)],
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+    reference: warpctc-backed ctc_loss. log_probs: [T, B, C]."""
+    ins = [_t(log_probs), _t(labels), _t(input_lengths), _t(label_lengths)]
+
+    def fn(lp, lab, ilen, llen):
+        T, B, C = lp.shape
+        lab = lab.astype(jnp.int32)
+        S = lab.shape[1]
+        # extended label sequence with blanks: length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        ext_len = 2 * llen.astype(jnp.int32) + 1
+
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < ilen.astype(jnp.int32))[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        idx_last = ext_len - 1
+        idx_prev = jnp.maximum(ext_len - 2, 0)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0],
+        )
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(llen.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return run_op("ctc_loss", fn, ins)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    ins = [_t(logit), _t(label)]
+    has_n = normalizer is not None
+    if has_n:
+        ins.append(_t(normalizer))
+
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jax.nn.softplus(-z) * y + jax.nn.softplus(z) * (1 - y)
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if has_n:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+
+    return run_op("sigmoid_focal_loss", fn, ins)

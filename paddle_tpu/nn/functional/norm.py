@@ -1,0 +1,234 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+layer_norm / rms_norm here are the jnp reference paths; the fused Pallas
+kernels (paddle_tpu.ops.pallas) override them for the shapes that matter —
+the analog of the reference's fused_layernorm / rms_norm CUDA kernels
+(paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu, gpu/rms_norm_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "normalize",
+    "layer_norm",
+    "batch_norm",
+    "instance_norm",
+    "group_norm",
+    "local_response_norm",
+    "rms_norm",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return run_op("normalize", fn, [_t(x)])
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+    ins = [_t(x)]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(_t(weight))
+    if has_b:
+        ins.append(_t(bias))
+
+    def fn(a, *rest):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        x32 = a.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * rest[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + rest[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return run_op("layer_norm", fn, ins)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py:59).
+    Stats in f32 regardless of input dtype, like the reference kernel."""
+    ins = [_t(x)]
+    has_w = weight is not None
+    if has_w:
+        ins.append(_t(weight))
+
+    def fn(a, *rest):
+        x32 = a.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * jax.lax.rsqrt(var + epsilon)
+        if rest:
+            out = out * rest[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return run_op("rms_norm", fn, ins)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """reference: python/paddle/nn/functional/norm.py batch_norm. In training,
+    running stats are updated in place on the buffer handles (eager); the jit
+    path threads buffers functionally via Layer.functional_state."""
+    xx = _t(x)
+    rm, rv = _t(running_mean), _t(running_var)
+    channels_last = not data_format.startswith("NC")
+    ch_axis = -1 if channels_last else 1
+    use_batch = training and not use_global_stats
+
+    ins = [xx, rm, rv]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(_t(weight))
+    if has_b:
+        ins.append(_t(bias))
+
+    def fn(a, m, v, *rest):
+        axes = tuple(i for i in range(a.ndim) if i != ch_axis % a.ndim)
+        shape = [1] * a.ndim
+        shape[ch_axis % a.ndim] = a.shape[ch_axis % a.ndim]
+        if use_batch:
+            x32 = a.astype(jnp.float32)
+            bm = jnp.mean(x32, axis=axes)
+            bv = jnp.var(x32, axis=axes)
+            mean, var = bm, bv
+        else:
+            mean, var = m.astype(jnp.float32), v.astype(jnp.float32)
+        out = (a.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon
+        )
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape).astype(jnp.float32)
+        if use_batch:
+            return out.astype(a.dtype), mean, var
+        return out.astype(a.dtype), m.astype(jnp.float32), v.astype(jnp.float32)
+
+    out, bm, bv = run_op("batch_norm", fn, ins)
+    if use_batch:
+        # momentum update of running stats (paddle: r = m*r + (1-m)*batch)
+        new_m = momentum * rm._value.astype(jnp.float32) + (1 - momentum) * bm._value
+        new_v = momentum * rv._value.astype(jnp.float32) + (1 - momentum) * bv._value
+        rm._value = new_m.astype(rm._value.dtype)
+        rv._value = new_v.astype(rv._value.dtype)
+        if isinstance(running_mean, Tensor) and running_mean is not rm:
+            running_mean._value = rm._value
+        if isinstance(running_var, Tensor) and running_var is not rv:
+            running_var._value = rv._value
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    ins = [_t(x)]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(_t(weight))
+    if has_b:
+        ins.append(_t(bias))
+
+    def fn(a, *rest):
+        axes = tuple(range(2, a.ndim))
+        x32 = a.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.var(x32, axis=axes, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape).astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return run_op("instance_norm", fn, ins)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    g = int(num_groups)
+    channels_last = not data_format.startswith("NC")
+    ins = [_t(x)]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(_t(weight))
+    if has_b:
+        ins.append(_t(bias))
+
+    def fn(a, *rest):
+        if channels_last:
+            a_ncx = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ncx = a
+        n, c = a_ncx.shape[:2]
+        spatial = a_ncx.shape[2:]
+        x32 = a_ncx.astype(jnp.float32).reshape(n, g, c // g, *spatial)
+        axes = tuple(range(2, x32.ndim))
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.var(x32, axis=axes, keepdims=True)
+        out = ((x32 - mean) * jax.lax.rsqrt(var + epsilon)).reshape(n, c, *spatial)
+        shape = [1, c] + [1] * len(spatial)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape).astype(jnp.float32)
+        out = out.astype(a.dtype)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return run_op("group_norm", fn, ins)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def fn(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[1] = size
+        summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window, [1] * a.ndim, "VALID")
+        return a / jnp.power(k + alpha * summed, beta)
+
+    return run_op("local_response_norm", fn, [_t(x)])

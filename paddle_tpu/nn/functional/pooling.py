@@ -1,0 +1,169 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+All lower to lax.reduce_window."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "avg_pool1d",
+    "avg_pool2d",
+    "avg_pool3d",
+    "max_pool1d",
+    "max_pool2d",
+    "max_pool3d",
+    "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d",
+    "adaptive_max_pool1d",
+    "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, ceil_mode, count_include_pad, data_format, is_avg):
+    channels_last = not data_format.startswith("NC")
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    pads = _pads(padding, n)
+
+    def fn(a):
+        nd = a.ndim
+        if channels_last:
+            spatial = list(range(1, nd - 1))
+        else:
+            spatial = list(range(2, nd))
+        window = [1] * nd
+        strides = [1] * nd
+        for i, ax in enumerate(spatial):
+            window[ax] = k[i]
+            strides[ax] = s[i]
+        if isinstance(pads, str):
+            padcfg = pads
+        else:
+            padcfg = [(0, 0)] * nd
+            for i, ax in enumerate(spatial):
+                padcfg[ax] = pads[i]
+        if is_avg:
+            ones = jnp.ones_like(a)
+            summed = jax.lax.reduce_window(a, 0.0 if a.dtype != jnp.bfloat16 else jnp.bfloat16(0), jax.lax.add, window, strides, padcfg)
+            if count_include_pad:
+                denom = float(np.prod(k))
+                return (summed / denom).astype(a.dtype)
+            counts = jax.lax.reduce_window(ones, 0.0 if a.dtype != jnp.bfloat16 else jnp.bfloat16(0), jax.lax.add, window, strides, padcfg)
+            return (summed / counts).astype(a.dtype)
+        return jax.lax.reduce_window(a, init(a.dtype), reducer, window, strides, padcfg)
+
+    return run_op("pool", fn, [_t(x)])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, None, ceil_mode, not exclusive, "NCL", True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, None, ceil_mode, not exclusive, data_format, True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, None, ceil_mode, not exclusive, data_format, True)
+
+
+def _neg_inf(dtype):
+    return jnp.asarray(-jnp.inf, dtype) if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype).min
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, _neg_inf, ceil_mode, False, "NCL", False)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, _neg_inf, ceil_mode, False, data_format, False)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, _neg_inf, ceil_mode, False, data_format, False)
+
+
+def _adaptive(x, output_size, n, is_avg, data_format="NCHW"):
+    xx = _t(x)
+    out_sizes = _tuple(output_size, n)
+
+    def fn(a):
+        nd = a.ndim
+        spatial = list(range(2, nd))
+        out = a
+        for i, ax in enumerate(spatial):
+            osz = out_sizes[i]
+            if osz is None:
+                continue
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                k = isz // osz
+                shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
+                r = out.reshape(shape)
+                out = jnp.mean(r, axis=ax + 1) if is_avg else jnp.max(r, axis=ax + 1)
+            else:
+                # general adaptive pooling: per-output-bin segments
+                starts = (np.arange(osz) * isz) // osz
+                ends = -(-((np.arange(osz) + 1) * isz) // osz)
+                slices = []
+                for st, en in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                    red = jnp.mean(seg, axis=ax, keepdims=True) if is_avg else jnp.max(seg, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return run_op("adaptive_pool", fn, [xx])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, True, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, True, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, False)

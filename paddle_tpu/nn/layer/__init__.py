@@ -1,0 +1,10 @@
+from . import activation, common, container, conv, layers, loss, norm, pooling, transformer
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .container import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .layers import Layer, ParamAttr  # noqa: F401
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .transformer import *  # noqa: F401,F403

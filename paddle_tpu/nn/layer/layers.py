@@ -1,0 +1,390 @@
+"""Layer: the module base class.
+
+Reference: paddle.nn.Layer (python/paddle/nn/layer/layers.py:353) — parameter /
+buffer / sublayer registries, hooks, state_dict with structured names,
+train/eval mode, dtype casting. Redesigned for JAX: parameters are
+Tensor handles over jax.Arrays, and `functional_state()` / `load_functional_state()`
+expose the layer tree as a pytree so the whole model drops into jax.jit /
+jax.grad / pjit without touching user code (paddle_tpu.jit builds on this).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import dtype as dtype_mod
+from ...framework.core import Parameter, Tensor
+from .. import initializer as I
+
+__all__ = ["Layer", "ParamAttr"]
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/base/param_attr.py)."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"cannot interpret {attr!r} as ParamAttr")
+
+
+_layer_counter = collections.defaultdict(int)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        cls = self.__class__.__name__.lower()
+        _layer_counter[cls] += 1
+        self._full_name = f"{name_scope or cls}_{_layer_counter[cls] - 1}"
+        self._dtype = dtype_mod.convert_dtype(dtype)
+        self.training = True
+        self._parameters: dict[str, Parameter] = collections.OrderedDict()
+        self._buffers: dict[str, Tensor] = collections.OrderedDict()
+        self._non_persistable_buffer_names: set[str] = set()
+        self._sub_layers: dict[str, Layer] = collections.OrderedDict()
+        self._forward_pre_hooks: dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: dict[int, Callable] = collections.OrderedDict()
+        self._hook_id = 0
+
+    # ------------------------------------------------------------------ #
+    # registration
+    # ------------------------------------------------------------------ #
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                params.pop(name)
+            if layers is not None and name in layers and value is None:
+                layers.pop(name)
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor):
+                    buffers[name] = value
+                else:
+                    buffers.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        object.__setattr__(self, str(name), sublayer)
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        """reference: Layer.create_parameter (nn/layer/layers.py) — default init
+        Xavier-uniform for weights, zeros for biases."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        d = dtype_mod.convert_dtype(dtype) if dtype is not None else self._dtype
+        shape = tuple(int(s) for s in shape)
+        p = Parameter(jnp.zeros(shape, jnp.dtype(d)), trainable=attr.trainable, name=attr.name)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = None
+        init = default_initializer or attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        init(p)
+        p.need_clip = getattr(attr, "need_clip", True)
+        return p
+
+    # ------------------------------------------------------------------ #
+    # traversal
+    # ------------------------------------------------------------------ #
+
+    def parameters(self, include_sublayers=True) -> list[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = f"{layer_prefix}.{pname}" if layer_prefix else pname
+                yield full, p
+
+    def buffers(self, include_sublayers=True) -> list[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = f"{layer_prefix}.{bname}" if layer_prefix else bname
+                yield full, b
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield "", prefix, self
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                for item in sub._walk(sub_prefix, True):
+                    yield item
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator:
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def sublayers(self, include_self=False) -> list["Layer"]:
+        out = []
+        for _, _, layer in self._walk("", True):
+            out.append(layer)
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for i, (_, lp, layer) in enumerate(self._walk(prefix, True)):
+            if i == 0 and not include_self:
+                continue
+            yield lp, layer
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ------------------------------------------------------------------ #
+    # modes / casting
+    # ------------------------------------------------------------------ #
+
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def _cast(self, d, only_float=True):
+        jd = jnp.dtype(d)
+        for layer in self.sublayers(include_self=True):
+            layer._dtype = d
+            for p in layer._parameters.values():
+                if p is not None and (not only_float or dtype_mod.is_floating_point_dtype(p.dtype)):
+                    p._value = p._value.astype(jd)
+            for name, b in layer._buffers.items():
+                if b is not None and dtype_mod.is_floating_point_dtype(b.dtype):
+                    b._value = b._value.astype(jd)
+
+    # ------------------------------------------------------------------ #
+    # state dict
+    # ------------------------------------------------------------------ #
+
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(structured_name_prefix, include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(structured_name_prefix, include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            owner = self._locate_owner(name)
+            if owner is not None and short in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def _locate_owner(self, qualified):
+        parts = qualified.split(".")[:-1]
+        layer = self
+        for p in parts:
+            layer = layer._sub_layers.get(p)
+            if layer is None:
+                return None
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Load matching entries; returns (missing_keys, unexpected_keys) like
+        the reference."""
+        own = self.state_dict()
+        missing, matched = [], set()
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            v = src._value if isinstance(src, Tensor) else jnp.asarray(src)
+            if tuple(v.shape) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: checkpoint {tuple(v.shape)} vs "
+                    f"parameter {tuple(target.shape)}"
+                )
+            target._value = v.astype(target._value.dtype)
+            matched.add(name)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------------ #
+    # functional bridge (TPU-native: expose the layer tree as a pytree)
+    # ------------------------------------------------------------------ #
+
+    def functional_state(self):
+        """Return ({name: param_value}, {name: buffer_value}) raw-jax pytrees."""
+        params = {k: p._value for k, p in self.named_parameters()}
+        bufs = {k: b._value for k, b in self.named_buffers()}
+        return params, bufs
+
+    def load_functional_state(self, params=None, buffers=None):
+        if params:
+            own = dict(self.named_parameters())
+            for k, v in params.items():
+                own[k]._value = v
+        if buffers:
+            own_b = dict(self.named_buffers())
+            for k, v in buffers.items():
+                own_b[k]._value = v
+
+    # ------------------------------------------------------------------ #
+    # hooks and call
+    # ------------------------------------------------------------------ #
+
+    def register_forward_pre_hook(self, hook):
+        hid = self._hook_id
+        self._hook_id += 1
+        self._forward_pre_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        hid = self._hook_id
+        self._hook_id += 1
+        self._forward_post_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_post_hooks, hid)
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            body = repr(sub).splitlines()
+            head = f"({name}): {body[0]}"
+            lines.append(head)
+            lines.extend("  " + b for b in body[1:])
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            return main + "\n  " + "\n  ".join(lines) + "\n)"
+        return main + ")"
+
+
+class _HookRemoveHelper:
+    def __init__(self, hooks, hid):
+        self._hooks, self._hid = hooks, hid
+
+    def remove(self):
+        self._hooks.pop(self._hid, None)

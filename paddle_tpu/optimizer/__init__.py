@@ -1,0 +1,21 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
+
+from . import lr
+from .optimizer import (
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Lars,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "Lars", "lr",
+]

@@ -1,0 +1,422 @@
+"""Optimizer base + the standard family (reference: python/paddle/optimizer/).
+
+Design: every optimizer defines a PURE update rule
+    init_state(param_value) -> state dict of jax arrays
+    update(param, grad, state, lr, ctx) -> (new_param, new_state)
+Eager `step()` walks params and applies it; the jit path
+(paddle_tpu.jit.functional_optimizer) maps the same rule over a params pytree
+inside one compiled program — replacing the reference's multi_tensor/fused
+optimizer kernels (paddle/phi/kernels/gpu/adamw_kernel.cu etc.) with one
+XLA-fused update.
+
+Master weights: with multi_precision=True bf16/f16 params keep an f32 master
+copy in the state (reference: master-weight support across optimizer kernels).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Parameter, Tensor, no_grad
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "Lars",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._states: dict[int, dict] = {}
+        self._step_count = 0
+
+    # -- lr ---------------------------------------------------------------- #
+
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("set_lr cannot be used with an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- pure update rule (overridden per optimizer) ----------------------- #
+
+    def init_state(self, p):
+        return {}
+
+    def update(self, p, g, state, lr, ctx):
+        raise NotImplementedError
+
+    def _decay_coeff(self):
+        wd = self._weight_decay
+        if hasattr(wd, "__float__"):
+            return float(wd)
+        return float(wd) if wd else 0.0
+
+    # -- eager step --------------------------------------------------------- #
+
+    def _get_state(self, param):
+        key = id(param)
+        st = self._states.get(key)
+        if st is None:
+            pv = param._value
+            st = self.init_state(pv)
+            if self._multi_precision and pv.dtype in (jnp.bfloat16, jnp.float16):
+                st["master"] = pv.astype(jnp.float32)
+            self._states[key] = st
+        return st
+
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        flat = []
+        for p in params:
+            if isinstance(p, dict):
+                flat.extend(p["params"])
+            else:
+                flat.append(p)
+        params_grads = [(p, p.grad) for p in flat if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        ctx = {"step": self._step_count, "weight_decay": self._decay_coeff()}
+        lr = self.get_lr()
+        for p, g in params_grads:
+            st = self._get_state(p)
+            pv = st.get("master", p._value)
+            gv = g._value.astype(pv.dtype)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else lr
+            new_p, new_st = self.update(pv, gv, {k: v for k, v in st.items() if k != "master"}, plr, ctx)
+            if "master" in st:
+                st["master"] = new_p
+                p._value = new_p.astype(p._value.dtype)
+            else:
+                p._value = new_p
+            for k, v in new_st.items():
+                st[k] = v
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=True):
+        params = self._parameter_list or []
+        for p in params:
+            if isinstance(p, dict):
+                for q in p["params"]:
+                    q.clear_grad()
+            else:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- state dict --------------------------------------------------------- #
+
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        flat = []
+        for p in self._parameter_list or []:
+            if isinstance(p, dict):
+                flat.extend(p["params"])
+            else:
+                flat.append(p)
+        for i, p in enumerate(flat):
+            st = self._states.get(id(p))
+            if st:
+                out[f"param_{i}"] = {k: Tensor(v) for k, v in st.items()}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("_step_count", 0)
+        flat = []
+        for p in self._parameter_list or []:
+            if isinstance(p, dict):
+                flat.extend(p["params"])
+            else:
+                flat.append(p)
+        for i, p in enumerate(flat):
+            key = f"param_{i}"
+            if key in state:
+                self._states[id(p)] = {
+                    k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in state[key].items()
+                }
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def update(self, p, g, state, lr, ctx):
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p, dtype=jnp.float32 if p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype)}
+
+    def update(self, p, g, state, lr, ctx):
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        v = self._momentum * state["velocity"].astype(g.dtype) + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - lr * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._decoupled = False  # Adam: L2 into grad; AdamW: decoupled
+
+    def init_state(self, p):
+        f32 = jnp.float32 if p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype
+        return {"m": jnp.zeros_like(p, dtype=f32), "v": jnp.zeros_like(p, dtype=f32)}
+
+    def update(self, p, g, state, lr, ctx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = ctx["step"]
+        wd = ctx["weight_decay"]
+        if wd and not self._decoupled:
+            g = g + wd * p
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1**t)
+        vhat = v / (1 - b2**t)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if wd and self._decoupled:
+            upd = upd + wd * p
+        return p - lr * upd, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name=name)
+        self._decoupled = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    @no_grad()
+    def step(self):
+        # honor apply_decay_param_fun by zeroing decay per param
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        base_wd = self._decay_coeff()
+        flat = []
+        for p in self._parameter_list or []:
+            if isinstance(p, dict):
+                flat.extend(p["params"])
+            else:
+                flat.append(p)
+        params_grads = [(p, p.grad) for p in flat if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            wd = base_wd if self._apply_decay_param_fun(p.name or "") else 0.0
+            ctx = {"step": self._step_count, "weight_decay": wd}
+            st = self._get_state(p)
+            pv = st.get("master", p._value)
+            gv = g._value.astype(pv.dtype)
+            new_p, new_st = self.update(pv, gv, {k: v for k, v in st.items() if k != "master"}, lr, ctx)
+            if "master" in st:
+                st["master"] = new_p
+                p._value = new_p.astype(p._value.dtype)
+            else:
+                p._value = new_p
+            st.update(new_st)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, ctx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = ctx["step"]
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        m = b1 * state["m"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["u"], jnp.abs(g))
+        return p - lr / (1 - b1**t) * m / (u + eps), {"m": m, "u": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def update(self, p, g, state, lr, ctx):
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        mom = state["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(mom) + self._epsilon), {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def init_state(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p), "avg_sq_update": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, ctx):
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        eps, rho = self._epsilon, self._rho
+        asg = rho * state["avg_sq_grad"] + (1 - rho) * jnp.square(g)
+        upd = jnp.sqrt(state["avg_sq_update"] + eps) / jnp.sqrt(asg + eps) * g
+        asu = rho * state["avg_sq_update"] + (1 - rho) * jnp.square(upd)
+        return p - lr * upd, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p), "velocity": jnp.zeros_like(p)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p)
+        return st
+
+    def update(self, p, g, state, lr, ctx):
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        v = self._momentum * state["velocity"] + lr * g / denom
+        new_state["velocity"] = v
+        return p - v, new_state
+
+
+class Lamb(Optimizer):
+    """reference: python/paddle/optimizer/lamb.py (and the fused
+    distributed_fused_lamb kernel) — layer-wise trust ratio on AdamW."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, p):
+        f32 = jnp.float32 if p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype
+        return {"m": jnp.zeros_like(p, dtype=f32), "v": jnp.zeros_like(p, dtype=f32)}
+
+    def update(self, p, g, state, lr, ctx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = ctx["step"]
+        wd = ctx["weight_decay"]
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1**t)
+        vhat = v / (1 - b2**t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+        w_norm = jnp.linalg.norm(p.reshape(-1))
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"m": m, "v": v}
+
+
+class Lars(Momentum):
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         weight_decay=lars_weight_decay, grad_clip=grad_clip,
+                         multi_precision=multi_precision, name=name)
+        self._lars_coeff = lars_coeff
+
+    def update(self, p, g, state, lr, ctx):
+        wd = ctx["weight_decay"]
+        w_norm = jnp.linalg.norm(p.reshape(-1))
+        g_norm = jnp.linalg.norm(g.reshape(-1))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + wd * w_norm + 1e-12),
+            1.0,
+        )
+        g = g + wd * p
+        v = self._momentum * state["velocity"].astype(g.dtype) + local_lr * g
+        return p - lr * v, {"velocity": v}
