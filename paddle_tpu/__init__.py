@@ -99,3 +99,9 @@ from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import amp  # noqa: E402
 from .nn.layer.layers import ParamAttr  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from . import jit  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model, summary  # noqa: E402

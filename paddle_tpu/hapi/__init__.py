@@ -1,0 +1,5 @@
+from . import callbacks
+from .callbacks import Callback, EarlyStopping, ModelCheckpoint, ProgBarLogger
+from .model import Model, summary
+
+__all__ = ["Model", "summary", "callbacks", "Callback", "EarlyStopping", "ModelCheckpoint", "ProgBarLogger"]

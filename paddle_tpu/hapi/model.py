@@ -1,0 +1,244 @@
+"""paddle.Model high-level API (reference: python/paddle/hapi/model.py:1472 —
+fit :1472ff, evaluate :2200, predict, save/load, summary).
+
+The training engine is jit-first: fit() drives a TrainStep (one compiled XLA
+program per step) instead of the reference's per-op dygraph loop.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad, to_tensor
+from ..io import DataLoader, Dataset
+from ..jit import TrainStep, functional_call
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, LRScheduler, ProgBarLogger
+
+__all__ = ["Model", "summary"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------ #
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        amp_level = None
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                amp_level = amp_configs
+            else:
+                amp_level = amp_configs.get("level", "O1")
+        self._amp_level = amp_level
+
+    def _get_train_step(self):
+        if self._train_step is None:
+            self._train_step = TrainStep(
+                self.network, self._loss, self._optimizer,
+                amp_level=getattr(self, "_amp_level", None),
+            )
+        return self._train_step
+
+    # ------------------------------------------------------------------ #
+
+    def train_batch(self, inputs, labels=None, update=True):
+        step = self._get_train_step()
+        loss = step(inputs, labels)
+        return [float(loss.numpy())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        step = self._get_train_step()
+        loss = step.evaluate(inputs, labels)
+        return [float(loss.numpy())]
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self.network.eval()
+        if self._train_step is not None:
+            self._train_step.sync_weights()
+        outs = self.network(*[i if isinstance(i, Tensor) else to_tensor(np.asarray(i)) for i in inputs])
+        self.network.train()
+        return [o.numpy() for o in (outs if isinstance(outs, (list, tuple)) else [outs])]
+
+    # ------------------------------------------------------------------ #
+
+    def _to_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle, num_workers=num_workers)
+        return data  # assume iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return [batch[0]], []
+        return [batch], []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._to_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        cbs = [ProgBarLogger(log_freq, verbose), LRScheduler()]
+        if callbacks:
+            cbs += list(callbacks)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cblist = CallbackList(cbs, model=self, params={"epochs": epochs, "steps": steps, "verbose": verbose})
+        self.stop_training = False
+        cblist.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            self.network.train()
+            losses = []
+            for step_i, batch in enumerate(loader):
+                cblist.on_train_batch_begin(step_i)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                losses.append(loss[0])
+                cblist.on_train_batch_end(step_i, {"loss": loss[0]})
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            cblist.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, batch_size=batch_size, verbose=0, num_workers=num_workers)
+                cblist.on_eval_end(eval_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cblist.on_train_end(logs if "logs" in dir() else None)
+        return self
+
+    @no_grad()
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        self.network.eval()
+        if self._train_step is not None:
+            self._train_step.sync_weights()
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            outs = self.network(*[i if isinstance(i, Tensor) else to_tensor(np.asarray(i)) for i in inputs])
+            outs_l = outs if isinstance(outs, (list, tuple)) else [outs]
+            if self._loss is not None and labels:
+                loss = self._loss(*outs_l, *labels)
+                losses.append(float(loss.numpy()))
+            for m in self._metrics:
+                res = m.compute(*outs_l, *labels)
+                if isinstance(res, tuple):
+                    m.update(*res)
+                else:
+                    m.update(res)
+        self.network.train()
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                for n, a in zip(name, acc):
+                    logs[n] = a
+            else:
+                logs[name] = acc
+        return logs
+
+    @no_grad()
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # ------------------------------------------------------------------ #
+
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+
+        if self._train_step is not None:
+            self._train_step.sync_weights()
+            self._train_step.sync_optimizer()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if self._train_step is not None:
+            # refresh device-side copies
+            self._train_step = None
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Parameter-count summary (reference: python/paddle/hapi/summary.py)."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':<12}"]
+    lines += [f"{name:<{width}}{str(shape):<20}{n:<12}" for name, shape, n in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

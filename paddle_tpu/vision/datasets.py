@@ -1,0 +1,132 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: no downloads. MNIST/Cifar load from local files when
+`data_file`/`image_path` is given; FakeData generates synthetic samples for
+pipelines and benchmarks.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = rng.randint(0, self.num_classes)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int32(label)
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py — reads the IDX
+    format from local files (no download)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or not os.path.exists(image_path or "")):
+            raise RuntimeError("downloads unavailable (zero-egress); pass image_path/label_path")
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    def _load(self, image_path, label_path):
+        with gzip.open(image_path, "rb") if image_path.endswith(".gz") else open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") if label_path.endswith(".gz") else open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8)
+        return images, labels
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """reference: python/paddle/vision/datasets/cifar.py — local pickle batches."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=False, backend=None):
+        if download and (data_file is None or not os.path.exists(data_file or "")):
+            raise RuntimeError("downloads unavailable (zero-egress); pass data_file")
+        self.transform = transform
+        with open(data_file, "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        self.data = batch[b"data"].reshape(-1, 3, 32, 32)
+        self.labels = batch.get(b"labels", batch.get(b"fine_labels"))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, np.int64(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class DatasetFolder(Dataset):
+    """Image-folder dataset; uses raw numpy loading for .npy, defers other
+    formats to a user loader."""
+
+    def __init__(self, root, loader=None, extensions=(".npy",), transform=None):
+        self.root = root
+        self.loader = loader or (lambda p: np.load(p))
+        self.transform = transform
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(label)

@@ -1,0 +1,223 @@
+"""Vision transforms on numpy arrays (reference: python/paddle/vision/transforms/).
+Transforms run on host (CPU) in DataLoader workers; tensors stay numpy until
+device dispatch."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomResizedCrop", "BrightnessTransform", "ContrastTransform",
+]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        out = img.astype(np.float32) / 255.0 if img.dtype == np.uint8 else img.astype(np.float32)
+        if self.data_format == "CHW":
+            out = out.transpose(2, 0, 1)
+        return out
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = img.astype(np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _target_hw(img, size):
+    if isinstance(size, numbers.Number):
+        h, w = img.shape[:2]
+        if h < w:
+            return int(size), int(size * w / h)
+        return int(size * h / w), int(size)
+    return int(size[0]), int(size[1])
+
+
+def _resize_np(img, size, interpolation="bilinear"):
+    """Host resize without PIL: nearest or bilinear."""
+    nh, nw = _target_hw(img, size)
+    h, w = img.shape[:2]
+    if interpolation == "nearest" or (nh == h and nw == w):
+        ri = (np.arange(nh) * h / nh).astype(np.int64).clip(0, h - 1)
+        ci = (np.arange(nw) * w / nw).astype(np.int64).clip(0, w - 1)
+        return img[ri][:, ci]
+    # bilinear, align_corners=False convention
+    src = img.astype(np.float32)
+    ry = (np.arange(nh) + 0.5) * h / nh - 0.5
+    rx = (np.arange(nw) + 0.5) * w / nw - 0.5
+    y0 = np.floor(ry).astype(np.int64)
+    x0 = np.floor(rx).astype(np.int64)
+    wy = (ry - y0)[:, None]
+    wx = (rx - x0)[None, :]
+    y0c = y0.clip(0, h - 1)
+    y1c = (y0 + 1).clip(0, h - 1)
+    x0c = x0.clip(0, w - 1)
+    x1c = (x0 + 1).clip(0, w - 1)
+    if src.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    top = src[y0c][:, x0c] * (1 - wx) + src[y0c][:, x1c] * wx
+    bot = src[y1c][:, x0c] * (1 - wx) + src[y1c][:, x1c] * wx
+    out = top * (1 - wy) + bot * wy
+    if img.dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return _resize_np(img, self.size, self.interpolation)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, [(p, p), (p, p)] + [(0, 0)] * (img.ndim - 2), mode="constant")
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[i:i + th, j:j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        p = self.padding
+        if isinstance(p, int):
+            pads = [(p, p), (p, p)]
+        else:
+            pads = [(p[1], p[3]), (p[0], p[2])] if len(p) == 4 else [(p[1], p[1]), (p[0], p[0])]
+        pads += [(0, 0)] * (img.ndim - 2)
+        return np.pad(img, pads, mode="constant", constant_values=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                return _resize_np(img[i:i + th, j:j + tw], self.size, self.interpolation)
+        return _resize_np(img, self.size, self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(img.astype(np.float32) * f, 0, 255 if img.dtype == np.uint8 else None).astype(img.dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        mean = img.mean()
+        return np.clip((img.astype(np.float32) - mean) * f + mean, 0, 255 if img.dtype == np.uint8 else None).astype(img.dtype)
