@@ -105,3 +105,5 @@ from . import vision  # noqa: E402
 from . import jit  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model, summary  # noqa: E402
+from . import distributed  # noqa: E402
+from .distributed import DataParallel  # noqa: E402

@@ -1,0 +1,68 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Architecture (SURVEY §5.8 TPU-native mapping):
+- env.py: process bootstrap (jax.distributed = TCPStore rendezvous) + the
+  global hybrid mesh [dp, pp, sharding, sep, mp]
+- collective.py: Group objects + eager collectives (global-array semantics)
+  + `primitives` (lax.psum/all_gather/ppermute/...) for shard_map bodies
+- fleet/: strategy, topology, facade, TP/SP layers, pipeline partitioning,
+  recompute
+- train_step.py: DistributedTrainStep — hybrid parallelism as compiled GSPMD
+- parallel.py: DataParallel + group_sharded (ZeRO) API
+- launch/: multi-host process launcher
+"""
+
+from . import collective, env, fleet, parallel, sharding
+from .collective import (
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    broadcast_object_list,
+    destroy_process_group,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    scatter_object_list,
+    send,
+    wait,
+)
+from .env import (
+    ParallelEnv,
+    build_mesh,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .parallel import DataParallel, group_sharded_parallel
+from .train_step import DistributedTrainStep
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "is_initialized", "build_mesh", "new_group", "get_group", "ReduceOp",
+    "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "broadcast", "broadcast_object_list", "scatter",
+    "scatter_object_list", "alltoall", "alltoall_single", "send", "recv",
+    "isend", "irecv", "barrier", "wait", "P2POp", "batch_isend_irecv",
+    "destroy_process_group", "fleet", "collective", "DataParallel",
+    "group_sharded_parallel", "DistributedTrainStep", "sharding",
+]
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn — on the single-controller TPU runtime,
+    in-process SPMD replaces process-per-device; run func once."""
+    func(*args)
+    return None

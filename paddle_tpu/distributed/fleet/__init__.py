@@ -1,0 +1,102 @@
+"""fleet facade (reference: python/paddle/distributed/fleet/fleet.py —
+init :218, _init_hybrid_parallel_env :674, distributed_model in model.py:33
+dispatching by parallel mode :135-185, distributed_optimizer :1448)."""
+
+from __future__ import annotations
+
+from ...framework.core import Parameter
+from .. import env as _env
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = [
+    "init",
+    "is_initialized",
+    "distributed_model",
+    "distributed_optimizer",
+    "get_hybrid_communicate_group",
+    "DistributedStrategy",
+    "worker_index",
+    "worker_num",
+    "HybridCommunicateGroup",
+    "CommunicateTopology",
+]
+
+_fleet_state = {"initialized": False, "strategy": None, "hcg": None}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """Build the hybrid topology + global mesh from strategy.hybrid_configs."""
+    if strategy is None:
+        strategy = DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        dims=(
+            hc["dp_degree"], hc["pp_degree"], hc["sharding_degree"],
+            hc["sep_degree"], hc["mp_degree"],
+        )
+    )
+    _env.init_parallel_env()
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return _fleet_state["hcg"]
+
+
+def worker_index():
+    return _env.get_rank()
+
+
+def worker_num():
+    return _env.get_world_size()
+
+
+def distributed_model(model):
+    """Wrap by parallel mode (reference fleet/model.py:135-185). On TPU the
+    wrappers annotate sharding metadata; the actual collectives are compiled
+    into the DistributedTrainStep."""
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    from ..parallel import DataParallel
+    from .meta_parallel import (
+        PipelineParallel,
+        SegmentParallel,
+        ShardingParallel,
+        TensorParallel,
+    )
+
+    mode = hcg.get_parallel_mode()
+    strategy = _fleet_state["strategy"]
+    if mode == "pipeline_parallel":
+        from .meta_parallel.pp_layers import PipelineLayer
+
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, strategy)
+        return TensorParallel(model, hcg, strategy)
+    if mode == "tensor_parallel":
+        return TensorParallel(model, hcg, strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy)
+    if mode == "segment_parallel":
+        return SegmentParallel(model, hcg, strategy)
+    if mode == "data_parallel":
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference fleet.py:1448 -> HybridParallelOptimizer
+    (dygraph_optimizer/hybrid_parallel_optimizer.py:275)."""
+    from .meta_optimizers import HybridParallelOptimizer
+
+    return HybridParallelOptimizer(
+        optimizer, _fleet_state["hcg"], strategy or _fleet_state["strategy"]
+    )

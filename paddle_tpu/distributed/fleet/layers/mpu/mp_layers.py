@@ -1,0 +1,161 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding :49, ColumnParallelLinear :336, RowParallelLinear :543,
+ParallelCrossEntropy :744 — implemented there with explicit _c_identity/
+_c_concat/allreduce ops around sharded weights.
+
+TPU-native redesign: the layer annotates its weight with a PartitionSpec
+(Parameter.dist_attr) and constrains activations; GSPMD inserts the identity/
+all-reduce/all-gather collectives when the surrounding train step is jitted
+over the mesh. Eagerly (single device, tests) the layers compute on the full
+weight — numerically identical by construction. The explicit-collective
+variants used inside shard_map bodies live in `primitives` form in
+paddle_tpu.distributed.collective.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from .....framework.core import Tensor, run_op
+from .....nn import initializer as I
+from .... import env as _env
+
+__all__ = [
+    "VocabParallelEmbedding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "ParallelCrossEntropy",
+    "mark_as_sequence_parallel",
+]
+
+
+def _mp_degree():
+    m = _env.get_global_mesh()
+    if m is None:
+        return 1
+    return m.shape.get("mp", 1)
+
+
+def _constrain(x: Tensor, spec: P) -> Tensor:
+    """with_sharding_constraint when inside a jit over the global mesh."""
+    mesh = _env.get_global_mesh()
+    if mesh is None:
+        return x
+
+    def fn(a):
+        import jax
+
+        try:
+            return jax.lax.with_sharding_constraint(
+                a, jax.sharding.NamedSharding(mesh, spec)
+            )
+        except Exception:
+            return a
+
+    return run_op("sharding_constraint", fn, [x])
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding with the vocab dim sharded over mp
+    (reference: mp_layers.py:49 — per-rank vocab range + allreduce)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal() if weight_attr is None else None,
+        )
+        self.weight.dist_attr = P("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Linear with output-features sharded over mp (reference: mp_layers.py:336)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = _mp_degree() > 1
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight.dist_attr = P(None, "mp")
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_attr = P("mp")
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            # keep activation sharded on the feature dim
+            spec = P(*([None] * (out.ndim - 1) + ["mp"]))
+            out = _constrain(out, spec)
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """Linear with input-features sharded over mp; output needs an allreduce
+    (reference: mp_layers.py:543) — GSPMD derives the psum from the contraction
+    over the sharded dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = _mp_degree() > 1
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight.dist_attr = P("mp", None)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = P(*([None] * (x.ndim - 1) + ["mp"]))
+            x = _constrain(x, spec)
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Cross entropy over mp-sharded logits (reference: mp_layers.py:744 —
+    c_softmax_with_cross_entropy kernel doing the max/sum allreduces). The
+    jnp log-softmax reductions over the sharded class dim lower to the same
+    psums under GSPMD."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        return F.cross_entropy(
+            input, label, reduction="none", ignore_index=self.ignore_index
+        )
+
+
+def mark_as_sequence_parallel(x: Tensor) -> Tensor:
+    """Constrain an activation [B, S, H] to be sequence-sharded over mp —
+    Megatron-SP's scatter (reference: fleet/utils/sequence_parallel_utils.py
+    ScatterOp). GSPMD materializes the all-gather where full sequences are
+    needed."""
+    spec = P(None, "mp", *([None] * (x.ndim - 2)))
+    return _constrain(x, spec)

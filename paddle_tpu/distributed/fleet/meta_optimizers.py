@@ -1,0 +1,60 @@
+"""Hybrid-parallel optimizer wrappers.
+
+Reference: HybridParallelOptimizer (python/paddle/distributed/fleet/
+meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:275) — wraps
+the inner optimizer with TP-aware grad clip and DP/sharding grad sync;
+DygraphShardingOptimizer (dygraph_sharding_optimizer.py:54) — ZeRO-1 param-
+to-rank assignment + post-step broadcast.
+
+TPU: grad sync and ZeRO sharding are placement properties of the compiled
+train step (DistributedTrainStep), so these wrappers mainly carry API and
+the global-norm clip semantics across the whole (replicated+sharded) param
+set — which the compiled clip already computes globally.
+"""
+
+from __future__ import annotations
+
+from ..collective import Group
+from ...optimizer.lr import LRScheduler
+
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    @property
+    def _learning_rate(self):
+        return self._inner_opt._learning_rate
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kw):
+        return self._inner_opt.minimize(loss, **kw)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    """ZeRO stage-1 (reference :54). On TPU the param-to-rank assignment is a
+    sharding spec over the `sharding` mesh axis applied to optimizer states
+    (DistributedTrainStep sharding_stage=1); the post-step broadcast is
+    implicit in GSPMD's output resharding."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        super().__init__(optimizer, hcg, strategy)
+        self.sharding_stage = 1

@@ -1,0 +1,66 @@
+"""Meta-parallel model wrappers (reference: python/paddle/distributed/fleet/
+meta_parallel/ — TensorParallel, ShardingParallel, SegmentParallel wrappers).
+
+On TPU these wrappers are thin: parameters already carry their sharding specs
+(set by the mpu layers or stage-3 annotation); the wrapper's reference job —
+param broadcast across groups, backward-hook grad sync — is subsumed by GSPMD
+in the compiled train step. They remain real Layer wrappers so user code
+behaves identically.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+
+__all__ = [
+    "MetaParallelBase",
+    "TensorParallel",
+    "ShardingParallel",
+    "SegmentParallel",
+    "PipelineParallel",
+    "PipelineLayer",
+    "LayerDesc",
+    "SharedLayerDesc",
+]
+
+
+class MetaParallelBase(nn.Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(MetaParallelBase):
+    """reference: meta_parallel/tensor_parallel.py."""
+
+
+class ShardingParallel(MetaParallelBase):
+    """reference: meta_parallel/sharding_parallel.py."""
+
+
+class SegmentParallel(MetaParallelBase):
+    """reference: meta_parallel/segment_parallel.py:26."""

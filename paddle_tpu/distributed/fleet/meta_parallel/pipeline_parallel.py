@@ -1,0 +1,97 @@
+"""PipelineParallel wrapper (reference: python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py — PipelineParallel :242,
+forward_backward_pipeline :684 (1F1B), train_batch :940, interleaved VPP
+:1308).
+
+TPU-native execution model: in the reference, pp ranks are processes
+exchanging activations over NCCL p2p in a hand-scheduled 1F1B loop. Under a
+single-controller mesh the schedule is *compiled*: train_batch splits the
+batch into micro-batches and drives them through the stage graph; the
+compiled collective-permute pipeline (paddle_tpu.parallel.pipeline) maps
+stages onto the `pp` mesh axis so micro-batch k+1's stage-0 work overlaps
+micro-batch k's stage-1 work inside one XLA program — the same steady-state
+overlap 1F1B achieves, scheduled by XLA instead of Python.
+
+This wrapper provides the reference API (train_batch with grad accumulation,
+micro-batching, scaler support) with eager semantics; the compiled pipeline
+path is engaged by GPT-style models through paddle_tpu.parallel.pipeline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....framework.core import Tensor, no_grad
+from . import pp_layers
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel:
+    def __init__(self, layers, hcg, strategy):
+        if not isinstance(layers, pp_layers.PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pp_cfg = strategy.hybrid_configs.get("pp_configs", {})
+        self.micro_batch_size = strategy.hybrid_configs.get("micro_batch_size") or \
+            pp_cfg.get("micro_batch_size", 1)
+        self.accumulate_steps = pp_cfg.get("accumulate_steps", 1)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Micro-batched forward/backward with grad accumulation
+        (reference train_batch :940 / forward_backward_pipeline :684)."""
+        x, y = data
+        x = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+        y = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+        total = x.shape[0]
+        mbs = self.micro_batch_size
+        n_micro = max(total // mbs, 1)
+        losses = []
+        for m in range(n_micro):
+            lo, hi = m * mbs, min((m + 1) * mbs, total)
+            xm, ym = x[lo:hi], y[lo:hi]
+            out = self._layers(xm)
+            loss = self._layers._loss_fn(out, ym)
+            scaled = loss * (1.0 / n_micro)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            losses.append(float(loss.numpy()))
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(np.asarray(np.mean(losses), np.float32))
+
+    @no_grad()
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x if isinstance(x, Tensor) else Tensor(np.asarray(x)))
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, y if isinstance(y, Tensor) else Tensor(np.asarray(y)))
+        return out
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)

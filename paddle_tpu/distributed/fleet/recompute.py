@@ -1,0 +1,126 @@
+"""Activation recomputation (reference: python/paddle/distributed/fleet/
+recompute/recompute.py — RecomputeFunction :128 replays forward under saved
+RNG state in backward; recompute_hybrid.py adds offload).
+
+TPU-native: jax.checkpoint (rematerialization) IS this feature, applied at
+trace time — XLA recomputes the segment in the backward pass, RNG is
+deterministic because keys are values. The eager path replays via PyLayer
+with rng_guard for exact reference semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ...autograd import PyLayer
+from ...framework import random as rnd
+from ...framework.core import Tensor, in_tracing, no_grad, run_op
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True, **kwargs):
+    """paddle.distributed.fleet.utils.recompute equivalent."""
+    if in_tracing():
+        # inside a jitted program: use XLA remat on the raw function
+        tensors = [a for a in args if isinstance(a, Tensor)]
+        others = [a for a in args if not isinstance(a, Tensor)]
+
+        def raw(*vals):
+            it = iter(vals)
+            rebuilt = [Tensor(next(it)) if isinstance(a, Tensor) else a for a in args]
+            out = function(*rebuilt, **kwargs)
+            return out._value if isinstance(out, Tensor) else tuple(o._value for o in out)
+
+        ck = jax.checkpoint(raw)
+        return run_op("recompute", ck, tensors)
+
+    tensor_args = tuple(a for a in args if isinstance(a, Tensor))
+    n_args = len(tensor_args)
+    trainable = _collect_trainable_params(function)
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *all_inputs):
+            ctx.rng = rnd.get_rng_state()
+            ctx.tensor_args = all_inputs[:n_args]
+            with no_grad():
+                out = function(*args, **kwargs)
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            from ...autograd import backward as autograd_backward
+            from ...framework.core import enable_grad
+
+            # replay with grad re-enabled (PyLayer backwards run under
+            # no_grad) and the saved RNG state, then run the real backward
+            with enable_grad(), rnd.rng_guard(ctx.rng[0]):
+                detached = [
+                    Tensor(t._value, stop_gradient=t.stop_gradient)
+                    for t in ctx.tensor_args
+                ]
+                it = iter(detached)
+                rebuilt = [next(it) if isinstance(a, Tensor) else a for a in args]
+                out = function(*rebuilt, **kwargs)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                autograd_backward(list(outs), list(grads), retain_graph=False)
+            # param grads accumulated directly on the live Parameters during
+            # the replay; return None for those slots
+            return tuple(d.grad if d.grad is not None else None for d in detached) + \
+                (None,) * len(trainable)
+
+    return _Recompute.apply(*tensor_args, *trainable)
+
+
+def _collect_trainable_params(function):
+    """Find trainable Parameters reachable from `function` so the recompute
+    PyLayer participates in the autograd graph even when the data inputs are
+    constants (params enter via closure, like the reference's detection of
+    trainable weights in RecomputeFunction)."""
+    from ...nn.layer.layers import Layer
+
+    seen = []
+
+    def from_layer(layer):
+        seen.extend(p for p in layer.parameters() if not p.stop_gradient)
+
+    if isinstance(function, Layer):
+        from_layer(function)
+    elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+        from_layer(function.__self__)
+    elif hasattr(function, "__closure__") and function.__closure__:
+        for cell in function.__closure__:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, Layer):
+                from_layer(v)
+            elif isinstance(v, Tensor) and not v.stop_gradient:
+                seen.append(v)
+    return seen
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute_sequential — chunked recompute over a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // segments, 1)
+    out = args[0] if len(args) == 1 else args
+
+    def seg_fn(layers_slice):
+        def run(x):
+            for l in layers_slice:
+                x = l(x)
+            return x
+
+        return run
+
+    i = 0
+    while i < n:
+        sl = layers[i:i + per]
+        out = recompute(seg_fn(sl), out, **kwargs)
+        i += per
+    return out

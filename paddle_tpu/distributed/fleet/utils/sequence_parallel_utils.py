@@ -1,0 +1,134 @@
+"""Sequence-parallel utilities.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-127),
+ColumnSequenceParallelLinear (:429), RowSequenceParallelLinear (:564),
+register_sequence_parallel_allreduce_hooks (:192).
+
+TPU-native: the scatter/gather pairs are sharding constraints on the sequence
+dim over the mp axis; GSPMD inserts all-gather/reduce-scatter at the TP
+boundary exactly where the reference places explicit PyLayers. The explicit
+PyLayer classes are kept for API parity and for eager single-device use,
+where they are identity maps (world=1) with the correct backward duals.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from ....autograd import PyLayer
+from ... import env as _env
+from ..layers.mpu.mp_layers import ColumnParallelLinear, RowParallelLinear, _constrain
+
+__all__ = [
+    "ScatterOp",
+    "GatherOp",
+    "AllGatherOp",
+    "ReduceScatterOp",
+    "identity_in_mp",
+    "mark_as_sequence_parallel_parameter",
+    "is_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear",
+    "RowSequenceParallelLinear",
+]
+
+_SP_MARK = "sequence_parallel"
+
+
+def _seq_spec(ndim, seq_axis=1):
+    spec = [None] * ndim
+    spec[seq_axis] = "mp"
+    return P(*spec)
+
+
+class ScatterOp(PyLayer):
+    """Split activation along seq dim across mp (fwd) / all-gather (bwd)."""
+
+    @staticmethod
+    def forward(ctx, x, axis=1):
+        ctx.axis = axis
+        return _constrain(x, _seq_spec(x.ndim, axis))
+
+    @staticmethod
+    def backward(ctx, g):
+        return _constrain(g, P(*([None] * g.ndim)))
+
+
+class GatherOp(PyLayer):
+    """All-gather along seq dim (fwd) / scatter (bwd)."""
+
+    @staticmethod
+    def forward(ctx, x, axis=1):
+        ctx.axis = axis
+        return _constrain(x, P(*([None] * x.ndim)))
+
+    @staticmethod
+    def backward(ctx, g):
+        return _constrain(g, _seq_spec(g.ndim, ctx.axis))
+
+
+class AllGatherOp(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        return _constrain(x, P(*([None] * x.ndim)))
+
+    @staticmethod
+    def backward(ctx, g):
+        return _constrain(g, _seq_spec(g.ndim, 1))
+
+
+class ReduceScatterOp(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        return _constrain(x, _seq_spec(x.ndim, 1))
+
+    @staticmethod
+    def backward(ctx, g):
+        return _constrain(g, P(*([None] * g.ndim)))
+
+
+def identity_in_mp(x):
+    return x
+
+
+def mark_as_sequence_parallel_parameter(param):
+    setattr(param, "_sp_mark", True)
+
+
+def is_sequence_parallel_parameter(param):
+    return getattr(param, "_sp_mark", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse=False):
+    """reference :192 — LN params used inside SP regions need grad allreduce
+    over mp. Under the compiled train step GSPMD already sums replicated-param
+    grads across mp; kept as an explicit no-op hook registry for API parity
+    in eager mode."""
+    return []
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """reference :429 — input arrives sequence-sharded; all-gather then
+    column-parallel matmul. Expressed as: constrain input to seq-sharded,
+    let GSPMD gather at the matmul."""
+
+    def forward(self, x):
+        x = _constrain(x, _seq_spec(x.ndim, 1))
+        out = F.linear(x, self.weight, self.bias)
+        spec = [None] * out.ndim
+        spec[-1] = "mp"
+        return _constrain(out, P(*spec))
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """reference :564 — row-parallel matmul then reduce-scatter onto seq dim."""
+
+    def forward(self, x):
+        spec = [None] * x.ndim
+        spec[-1] = "mp"
+        x = _constrain(x, P(*spec))
+        out = F.linear(x, self.weight, self.bias)
+        return _constrain(out, _seq_spec(out.ndim, 1))

@@ -1,0 +1,17 @@
+"""python -m paddle_tpu.distributed.launch — multi-host process launcher.
+
+Reference: python/paddle/distributed/launch/main.py:23 + CollectiveController
+(controllers/collective.py:22) which builds the pod, exports
+PADDLE_TRAINER_ENDPOINTS/PADDLE_MASTER/rank envs (:126-150) and spawns one
+process per device.
+
+TPU model: ONE controller process per host (not per chip); jax.distributed
+handles rendezvous via the coordinator address. The launcher therefore spawns
+a single local trainer per host, wiring the same env-var contract so
+reference-style launch scripts work unchanged.
+"""
+
+from .main import launch
+
+if __name__ == "__main__":
+    launch()

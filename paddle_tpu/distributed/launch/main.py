@@ -1,0 +1,82 @@
+"""Launcher implementation (reference: python/paddle/distributed/launch/main.py)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch"]
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None, help="coordinator ip:port (rank-0 host)")
+    p.add_argument("--nnodes", default="1", help="number of hosts (N or N:M)")
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="controller processes per host (TPU: 1)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None, help="accepted for parity; TPU devices are auto-discovered")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch():
+    args = _parse()
+    nnodes = int(str(args.nnodes).split(":")[0])
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for local in range(args.nproc_per_node):
+        env = dict(os.environ)
+        env["PADDLE_TRAINER_ID"] = str(args.rank * args.nproc_per_node + local)
+        env["PADDLE_TRAINERS_NUM"] = str(nnodes * args.nproc_per_node)
+        env["PADDLE_LOCAL_RANK"] = str(local)
+        env["PADDLE_JOB_ID"] = args.job_id
+        if args.master:
+            env["PADDLE_MASTER"] = args.master
+            env["JAX_COORDINATOR_ADDRESS"] = args.master
+        log_path = os.path.join(args.log_dir, f"workerlog.{local}")
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, args.training_script, *args.training_script_args],
+                env=env, stdout=logf if args.nproc_per_node > 1 else None,
+                stderr=subprocess.STDOUT if args.nproc_per_node > 1 else None,
+            )
+        procs.append(proc)
+
+    def _terminate(signum, frame):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
+    exit_code = 0
+    try:
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    # a failed trainer kills the pod (reference watcher behavior)
+                    exit_code = ret
+                    for q in procs:
+                        q.terminate()
+                    procs.clear()
+                    break
+            time.sleep(0.5)
+    finally:
+        for p in procs:
+            p.terminate()
+    sys.exit(exit_code)

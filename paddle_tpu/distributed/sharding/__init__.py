@@ -1,0 +1,4 @@
+"""paddle.distributed.sharding (reference: python/paddle/distributed/sharding/
+group_sharded.py:50)."""
+
+from ..parallel import group_sharded_parallel, save_group_sharded_model  # noqa: F401

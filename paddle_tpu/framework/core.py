@@ -545,7 +545,7 @@ class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase);
     stop_gradient defaults to False and it carries a trainable flag."""
 
-    __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer", "need_clip")
+    __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer", "need_clip", "dist_attr")
 
     def __init__(self, value, trainable: bool = True, name: str | None = None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -554,6 +554,9 @@ class Parameter(Tensor):
         self.is_distributed = False
         self.regularizer = None
         self.need_clip = True
+        # distributed placement: a jax PartitionSpec (or None = replicated);
+        # consumed by distributed.DistributedTrainStep (GSPMD partitioning)
+        self.dist_attr = None
 
 
 def _normalize_index(idx):
