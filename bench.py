@@ -1,0 +1,99 @@
+"""Headline benchmark: GPT-3 decoder training step on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric is model FLOPs utilization (MFU) of the full train step
+(fwd+bwd+AdamW) — the BASELINE.md north star is >=45% MFU, so
+vs_baseline = mfu / 0.45.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import numpy as np
+
+# chip kind -> peak bf16 FLOP/s (public spec sheets)
+_PEAK = {
+    "TPU v2": 22.5e12,
+    "TPU v3": 61.0e12,  # per chip (2 cores)
+    "TPU v4": 137.5e12,  # per chip (megacore)
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 229.5e12,
+    "TPU v5p": 229.5e12,
+    "TPU v6 lite": 459e12,
+    "TPU v6e": 459e12,
+    "TPU7x": 2307e12,
+}
+
+
+def _peak_flops(device):
+    kind = getattr(device, "device_kind", "")
+    for k, v in _PEAK.items():
+        if kind.startswith(k) or k in kind:
+            return v, kind
+    # CPU smoke runs / unknown chips: assume v4-class so the line still prints
+    return 137.5e12, kind or "unknown"
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import gpt3_1p3b, gpt3_125m, GPTForCausalLM, GPTPretrainingCriterion
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    cfg_name = os.environ.get("BENCH_CONFIG", "gpt3_1p3b" if on_tpu else "gpt3_125m_cpu")
+    if cfg_name == "gpt3_1p3b":
+        cfg = gpt3_1p3b(max_position_embeddings=2048)
+        batch, seq, steps = 4, 2048, 10
+    elif cfg_name == "gpt3_125m":
+        cfg = gpt3_125m(max_position_embeddings=2048)
+        batch, seq, steps = 8, 2048, 10
+    else:  # tiny CPU smoke
+        from paddle_tpu.models import GPTConfig
+        cfg = GPTConfig(hidden_size=256, num_layers=4, num_heads=4, vocab_size=8192,
+                        max_position_embeddings=512)
+        batch, seq, steps = 2, 256, 3
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    mesh = dist.build_mesh(devices=jax.devices()[:1])
+    step = dist.DistributedTrainStep(model, lambda lg, lb: crit(lg, lb), optimizer, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
+
+    loss = step(ids, labels)  # compile + warmup
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _i in range(steps):
+        loss = step(ids, labels)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
+    tokens = batch * seq
+    # 6ND fwd+bwd + attention quadratic term (12*L*h*T^2 per token batch)
+    flops = 6.0 * n_params * tokens + 12.0 * cfg.num_layers * cfg.hidden_size * seq * tokens
+    peak, kind = _peak_flops(jax.devices()[0])
+    mfu = flops / dt / peak
+    print(json.dumps({
+        "metric": f"mfu_{cfg_name}_bs{batch}x{seq}_{kind.replace(' ', '_')}",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "tokens_per_sec_per_chip": round(tokens / dt, 1),
+        "step_time_s": round(dt, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

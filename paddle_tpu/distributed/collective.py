@@ -155,6 +155,21 @@ class _Task:
         return True
 
 
+def _reduce_over_axis(val, op, axis):
+    import jax.numpy as jnp
+
+    fns = {
+        ReduceOp.SUM: jnp.sum, "sum": jnp.sum,
+        ReduceOp.MAX: jnp.max, "max": jnp.max,
+        ReduceOp.MIN: jnp.min, "min": jnp.min,
+        ReduceOp.PROD: jnp.prod, "prod": jnp.prod,
+        ReduceOp.AVG: jnp.mean, "avg": jnp.mean,
+    }
+    if op not in fns:
+        raise ValueError(f"unsupported reduce op {op!r}")
+    return fns[op](val, axis=axis)
+
+
 def _reduce_stacked(val, op, n):
     import jax.numpy as jnp
 
@@ -238,7 +253,8 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
     stacked = jnp.stack(vals, axis=0)  # [nranks(dst), nranks(src)?...]
     if vals[0].ndim >= 1 and vals[0].shape[0] == g.nranks:
         # each list entry is itself stacked per-source: reduce over source
-        red = jnp.sum(stacked, axis=1) if op == ReduceOp.SUM else _reduce_stacked(stacked, op, g.nranks)[0]
+        # (axis 1 of [dst, src, ...]) so entry j keeps dst j's result
+        red = _reduce_over_axis(stacked, op, axis=1)
         tensor._value = red if red.shape == tensor._value.shape else red.reshape(tensor._value.shape)
     else:
         red = _reduce_stacked(stacked, op, g.nranks)[0]
@@ -300,34 +316,67 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all. Stacked global view [src, dst_chunks...]:
+    rank i's row is the concat of chunks for each destination, so the global
+    transform is the (src, dst) chunk-grid transpose — identical to what
+    lax.all_to_all compiles to over a mesh axis."""
     import jax.numpy as jnp
 
     g = _grp(group)
     n = g.nranks
     v = in_tensor._value
-    if v.shape[0] % n == 0:
-        parts = v.reshape(n, v.shape[0] // n, *v.shape[1:])
-        # stacked semantics: [src*(per)] -> transpose chunk grid
-        out_tensor._value = parts.reshape(v.shape)
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "unequal split sizes are not supported by the eager "
+            "alltoall_single; use equal chunks or the compiled primitives"
+        )
+    if n > 1 and v.ndim >= 1 and v.shape[0] % (n * n) == 0:
+        # full stacked view: [src(n) * dst(n) * per, ...]
+        per = v.shape[0] // (n * n)
+        grid = v.reshape(n, n, per, *v.shape[1:])  # [src, dst, per, ...]
+        out_tensor._value = jnp.swapaxes(grid, 0, 1).reshape(v.shape)
     else:
+        # replicated single-rank view: every rank holds the same array and
+        # sends chunk j to rank j — with identical inputs the result is the
+        # input (chunk j received from every src is the same chunk j)
         out_tensor._value = v
     return _Task(out_tensor)
 
 
 # -- p2p: host-side mailbox for single-controller API parity ----------------- #
+# FIFO channels keyed (group id, src, dst). The single controller plays every
+# rank, so recv matches on src and falls back to any destination — a
+# send(dst=j) / recv(src=i) pair always pairs up regardless of which "rank"
+# the caller is emulating (reference: ncclSend/ncclRecv rendezvous).
 
 _mailbox: dict = {}
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
     g = _grp(group)
-    _mailbox.setdefault((g.id, dst), []).append(tensor._value)
+    src = max(g.rank, 0)
+    _mailbox.setdefault((g.id, src, dst), []).append(tensor._value)
     return _Task()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     g = _grp(group)
-    box = _mailbox.get((g.id, max(g.rank, 0)), [])
+    me = max(g.rank, 0)
+    # single-controller: the process plays every rank, so src/dst stamps on
+    # both sides reflect the controller's rank, not the emulated one. Match
+    # progressively: exact channel, then same-src any-dst, then any pending
+    # message in the group (FIFO pairing, like an in-order rendezvous).
+    box = _mailbox.get((g.id, src, me))
+    if not box:
+        box = next(
+            (b for (gid, s, _d), b in _mailbox.items() if gid == g.id and s == src and b),
+            None,
+        )
+    if not box:
+        box = next(
+            (b for (gid, _s, _d), b in _mailbox.items() if gid == g.id and b),
+            None,
+        )
     if box:
         tensor._value = box.pop(0)
     return _Task(tensor)

@@ -100,17 +100,25 @@ class HybridCommunicateGroup:
         self._groups = {}
         for name in topology.get_hybrid_group_names():
             axis = _NAME_TO_AXIS[name]
-            ranks = topology.get_axis_list(name, 0)
             comm = topology.get_comm_list(name)
             my = next((g for g in comm if self.global_rank in g), comm[0])
             self._groups[name] = coll.Group(ranks=my, axis_names=(axis,), mesh=self.mesh)
-        # fused dp+sharding group (reference topology.py:256-260)
-        dp_sharding_ranks = sorted(
-            set(self._groups["data"].ranks) | set(self._groups["sharding"].ranks)
+        # fused-axis groups (reference topology.py:256-260): all ranks that
+        # share this rank's coordinates on every OTHER axis
+        self._dp_sharding_group = self._fused_group(("data", "sharding"), ("dp", "sharding"))
+        self._dp_sep_group = self._fused_group(("data", "sep"), ("dp", "sep"))
+
+    def _fused_group(self, names, axes):
+        fixed = [n for n in self._topo.get_hybrid_group_names() if n not in names]
+        ranks = sorted(
+            r for r in range(self.nranks)
+            if all(
+                self._topo.get_coord(r)[self._topo.get_hybrid_group_names().index(n)]
+                == self._coord[n]
+                for n in fixed
+            )
         )
-        self._dp_sharding_group = coll.Group(
-            ranks=dp_sharding_ranks, axis_names=("dp", "sharding"), mesh=self.mesh
-        )
+        return coll.Group(ranks=ranks, axis_names=axes, mesh=self.mesh)
 
     # -- topology info (reference HybridCommunicateGroup API) -------------- #
 
@@ -200,7 +208,7 @@ class HybridCommunicateGroup:
         return self._groups["sep"]
 
     def get_dp_sep_parallel_group(self):
-        return self._dp_sharding_group
+        return self._dp_sep_group
 
     def get_pipe_parallel_peers(self):
         return self._groups["pipe"].ranks

@@ -14,8 +14,6 @@ set — which the compiled clip already computes globally.
 
 from __future__ import annotations
 
-from ..collective import Group
-from ...optimizer.lr import LRScheduler
 
 __all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]
 

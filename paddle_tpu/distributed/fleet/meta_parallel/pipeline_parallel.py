@@ -56,6 +56,11 @@ class PipelineParallel:
         y = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
         total = x.shape[0]
         mbs = self.micro_batch_size
+        if total % mbs != 0:
+            # reference asserts divisibility (pipeline_parallel.py:940 path)
+            raise ValueError(
+                f"batch size {total} is not divisible by micro_batch_size {mbs}"
+            )
         n_micro = max(total // mbs, 1)
         losses = []
         for m in range(n_micro):

@@ -70,8 +70,6 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         shard_params_for_stage3(model)
     optimizer._sharding_stage = stage
     model._sharding_stage = stage
-    if scaler is not None:
-        return model, optimizer, scaler
     return model, optimizer, scaler
 
 

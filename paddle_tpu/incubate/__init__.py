@@ -1,0 +1,6 @@
+"""paddle.incubate equivalent — fused-op APIs and experimental features
+(reference: python/paddle/incubate/)."""
+
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

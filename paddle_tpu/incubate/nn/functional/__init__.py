@@ -1,0 +1,372 @@
+"""Fused-op functional APIs (reference: python/paddle/incubate/nn/functional/).
+
+The reference backs each of these with a hand-written CUDA fusion kernel
+(SURVEY §2.2). On TPU the fusion itself is XLA's job — these entry points
+express the op as a single traceable function so XLA fuses the whole epilogue
+into the surrounding matmuls; the hot ones additionally route to Pallas
+kernels on TPU (paddle_tpu.ops.pallas) where XLA's automatic fusion is not
+enough (flash attention; see nn/functional/flash_attention.py).
+
+API parity targets:
+- swiglu                              (python/paddle/incubate/nn/functional/swiglu.py:26)
+- fused_rotary_position_embedding     (.../fused_rotary_position_embedding.py;
+                                       kernel paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu)
+- fused_rms_norm                      (.../fused_rms_norm.py:59;
+                                       kernel fusion/gpu/fused_layernorm_kernel.cu)
+- fused_layer_norm                    (.../fused_layer_norm.py)
+- fused_bias_act                      (kernel fusion/gpu/fused_bias_act_kernel.cu)
+- fused_dropout_add                   (kernel gpu/fused_dropout_add_kernel.cu)
+- fused_linear / fused_linear_activation
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "swiglu",
+    "fused_rotary_position_embedding",
+    "fused_rms_norm",
+    "fused_layer_norm",
+    "fused_bias_act",
+    "fused_dropout_add",
+    "fused_linear",
+    "fused_linear_activation",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y; single-input form splits x in half on the last dim
+    (reference: swiglu.py:26, kernel paddle/phi/kernels/gpu/ swiglu)."""
+    if y is None:
+        def fn(a):
+            u, v = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(u.astype(jnp.float32)).astype(a.dtype) * v
+
+        return run_op("swiglu", fn, [_t(x)])
+
+    def fn2(a, b):
+        return jax.nn.silu(a.astype(jnp.float32)).astype(a.dtype) * b
+
+    return run_op("swiglu", fn2, [_t(x), _t(y)])
+
+
+def _rope_tables(seq_len, head_dim, theta, dtype, position_ids=None):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if position_ids is None:
+        pos = jnp.arange(seq_len, dtype=jnp.float32)[None, :]  # [1, S]
+    else:
+        pos = position_ids.astype(jnp.float32)  # [B, S]
+    freqs = pos[..., None] * inv[None, None, :]  # [B?, S, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _apply_rope_one(x, cos, sin, neox):
+    """x: [B, S, H, D]. neox style rotates (x[..., :D/2], x[..., D/2:]) pairs;
+    GPT-J style rotates interleaved even/odd lanes (the reference's
+    use_neox_rotary_style flag, fused_rope_utils.h)."""
+    f32 = jnp.float32
+    c = cos[:, :, None, :].astype(f32)
+    s = sin[:, :, None, :].astype(f32)
+    xf = x.astype(f32)
+    if neox:
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    else:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def fused_rotary_position_embedding(
+    q,
+    k=None,
+    v=None,
+    sin=None,
+    cos=None,
+    position_ids=None,
+    use_neox_rotary_style=True,
+    time_major=False,
+    rotary_emb_base=10000.0,
+    name=None,
+):
+    """Rotary embedding on q/k(/v), layout [B, S, H, D]
+    (reference: fused_rotary_position_embedding.py; kernel fused_rope_kernel.cu).
+    Returns a tuple matching the number of non-None inputs."""
+    tensors = [_t(q)]
+    has_k = k is not None
+    has_v = v is not None
+    if has_k:
+        tensors.append(_t(k))
+    if has_v:
+        tensors.append(_t(v))
+    ext = []
+    has_tables = sin is not None and cos is not None
+    if has_tables:
+        ext = [_t(cos), _t(sin)]
+    if position_ids is not None:
+        ext.append(_t(position_ids))
+    has_pos = position_ids is not None
+    n_qkv = len(tensors)
+
+    def fn(*args):
+        qkv = list(args[:n_qkv])
+        rest = list(args[n_qkv:])
+        if time_major:
+            qkv = [jnp.swapaxes(t, 0, 1) for t in qkv]
+        B, S, H, D = qkv[0].shape
+        if has_tables:
+            c, s = rest[0], rest[1]
+            rest = rest[2:]
+            # reference accepts [1, S, 1, D] or [S, D]; canonicalize to [B?, S, D/2]
+            c = c.reshape(-1, c.shape[-1] if c.ndim > 1 else c.shape[0])[-S:, :]
+            s = s.reshape(-1, s.shape[-1])[-S:, :]
+            if c.shape[-1] == D:  # full-D tables store each half/duplicate
+                c = c[:, : D // 2] if use_neox_rotary_style else c[:, 0::2]
+                s = s[:, : D // 2] if use_neox_rotary_style else s[:, 0::2]
+            c = c[None]
+            s = s[None]
+            if has_pos:
+                pid = rest[0].astype(jnp.int32)
+                c = jnp.take(c[0], pid, axis=0)
+                s = jnp.take(s[0], pid, axis=0)
+        else:
+            pid = rest[0] if has_pos else None
+            c, s = _rope_tables(S, D, rotary_emb_base, qkv[0].dtype, pid)
+        outs = [_apply_rope_one(t, c, s, use_neox_rotary_style) for t in qkv]
+        if time_major:
+            outs = [jnp.swapaxes(t, 0, 1) for t in outs]
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    out = run_op("fused_rope", fn, tensors + ext)
+    if n_qkv == 1:
+        return (out, None, None)
+    outs = list(out) + [None] * (3 - n_qkv)
+    return tuple(outs)
+
+
+def fused_rms_norm(
+    x,
+    norm_weight,
+    norm_bias=None,
+    epsilon=1e-6,
+    begin_norm_axis=-1,
+    bias=None,
+    residual=None,
+    quant_scale=-1,
+    quant_round_type=0,
+    quant_max_bound=0,
+    quant_min_bound=0,
+    name=None,
+):
+    """RMSNorm fused with optional residual-add + bias
+    (reference: fused_rms_norm.py:59; fused_layernorm_kernel.cu residual path).
+    Returns (out, residual_out) like the reference."""
+    ins = [_t(x), _t(norm_weight)]
+    has_nb = norm_bias is not None
+    has_b = bias is not None
+    has_r = residual is not None
+    for extra, flag in ((norm_bias, has_nb), (bias, has_b), (residual, has_r)):
+        if flag:
+            ins.append(_t(extra))
+
+    def fn(a, w, *rest):
+        i = 0
+        nb = rest[i] if has_nb else None
+        i += has_nb
+        b = rest[i] if has_b else None
+        i += has_b
+        r = rest[i] if has_r else None
+        h = a.astype(jnp.float32)
+        if b is not None:
+            h = h + b.astype(jnp.float32)
+        if r is not None:
+            h = h + r.astype(jnp.float32)
+        res_out = h.astype(a.dtype)
+        axes = tuple(range(begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis, a.ndim))
+        var = jnp.mean(jnp.square(h), axis=axes, keepdims=True)
+        out = h * jax.lax.rsqrt(var + epsilon) * w.astype(jnp.float32)
+        if nb is not None:
+            out = out + nb.astype(jnp.float32)
+        return out.astype(a.dtype), res_out
+
+    out, res_out = run_op("fused_rms_norm", fn, ins)
+    return out, res_out
+
+
+def fused_layer_norm(
+    x,
+    norm_weight,
+    norm_bias=None,
+    epsilon=1e-5,
+    begin_norm_axis=-1,
+    bias=None,
+    residual=None,
+    residual_alpha=1.0,
+    quant_scale=-1,
+    quant_round_type=0,
+    quant_max_bound=0,
+    quant_min_bound=0,
+    name=None,
+):
+    """LayerNorm fused with residual-add (+alpha) and bias
+    (reference: fused_layer_norm.py; residual_alpha at
+    fused_layernorm_kernel.cu:1003). Returns (out, residual_out)."""
+    ins = [_t(x), _t(norm_weight)]
+    has_nb = norm_bias is not None
+    has_b = bias is not None
+    has_r = residual is not None
+    for extra, flag in ((norm_bias, has_nb), (bias, has_b), (residual, has_r)):
+        if flag:
+            ins.append(_t(extra))
+
+    def fn(a, w, *rest):
+        i = 0
+        nb = rest[i] if has_nb else None
+        i += has_nb
+        b = rest[i] if has_b else None
+        i += has_b
+        r = rest[i] if has_r else None
+        h = a.astype(jnp.float32)
+        if b is not None:
+            h = h + b.astype(jnp.float32)
+        if r is not None:
+            h = h + r.astype(jnp.float32) * residual_alpha
+        res_out = h.astype(a.dtype)
+        ax = begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis
+        axes = tuple(range(ax, a.ndim))
+        mean = jnp.mean(h, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(h - mean), axis=axes, keepdims=True)
+        out = (h - mean) * jax.lax.rsqrt(var + epsilon) * w.astype(jnp.float32)
+        if nb is not None:
+            out = out + nb.astype(jnp.float32)
+        return out.astype(a.dtype), res_out
+
+    out, res_out = run_op("fused_layer_norm", fn, ins)
+    return out, res_out
+
+
+_ACTS = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "geglu": None,  # handled below (gated)
+    "swiglu": None,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "identity": lambda x: x,
+}
+
+
+def fused_bias_act(
+    x,
+    bias=None,
+    dequant_scales=None,
+    shift=None,
+    smooth=None,
+    act_method="gelu",
+    compute_dtype="default",
+    quant_scale=-1,
+    quant_round_type=0,
+    quant_max_bound=0,
+    quant_min_bound=0,
+    name=None,
+):
+    """bias-add + activation epilogue (reference: fused_bias_act_kernel.cu;
+    python API incubate/nn/functional/fused_bias_act). Gated acts (geglu /
+    swiglu) halve the last dim."""
+    ins = [_t(x)]
+    has_b = bias is not None
+    if has_b:
+        ins.append(_t(bias))
+    method = act_method.lower()
+
+    def fn(a, *rest):
+        h = a.astype(jnp.float32)
+        if has_b:
+            h = h + rest[0].astype(jnp.float32)
+        if method in ("geglu", "swiglu"):
+            u, v = jnp.split(h, 2, axis=-1)
+            g = jax.nn.gelu(u, approximate=False) if method == "geglu" else jax.nn.silu(u)
+            out = g * v
+        else:
+            out = _ACTS[method](h)
+        return out.astype(a.dtype)
+
+    return run_op("fused_bias_act", fn, ins)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      seed=None, name=None):
+    """dropout(x) + y in one op (reference: fused_dropout_add_kernel.cu,
+    python/paddle/incubate/nn/functional/fused_dropout_add.py)."""
+    ins = [_t(x), _t(y)]
+    if not training or p == 0.0:
+        return run_op("fused_dropout_add", lambda a, b: a + b, ins)
+    from ....framework import random as rnd
+
+    key = rnd.next_key()
+
+    def fn(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            d = jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        else:
+            d = jnp.where(keep, a, 0.0).astype(a.dtype)
+        return d + b
+
+    return run_op("fused_dropout_add", fn, ins)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """matmul + bias epilogue (reference: incubate fused_linear →
+    cutlass gemm_epilogue)."""
+    ins = [_t(x), _t(weight)]
+    has_b = bias is not None
+    if has_b:
+        ins.append(_t(bias))
+
+    def fn(a, w, *rest):
+        if transpose_weight:
+            w = w.T
+        out = jnp.matmul(a, w)
+        if has_b:
+            out = out + rest[0]
+        return out
+
+    return run_op("fused_linear", fn, ins)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """matmul + bias + act (reference: fused_gemm_epilogue)."""
+    ins = [_t(x), _t(y), _t(bias)]
+    method = activation.lower()
+
+    if method not in ("none",) and _ACTS.get(method) is None and method not in ("geglu", "swiglu"):
+        raise ValueError(f"unsupported activation {activation!r}")
+
+    def fn(a, w, b):
+        if trans_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if trans_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = jnp.matmul(a, w) + b
+        if method in ("geglu", "swiglu"):
+            h = out.astype(jnp.float32)
+            u, vv = jnp.split(h, 2, axis=-1)
+            gate = jax.nn.gelu(u, approximate=False) if method == "geglu" else jax.nn.silu(u)
+            out = (gate * vv).astype(out.dtype)
+        elif method != "none":
+            out = _ACTS[method](out.astype(jnp.float32)).astype(out.dtype)
+        return out
+
+    return run_op("fused_linear_activation", fn, ins)

@@ -1,0 +1,37 @@
+"""Flagship model families (the training configs from BASELINE.md).
+
+The reference ships vision models in-tree (python/paddle/vision/models/) and
+serves LLMs through fleet-parallel layer building blocks
+(python/paddle/distributed/fleet/layers/mpu/mp_layers.py) that PaddleNLP
+assembles into GPT/LLaMA. Here the assembled decoder LM is in-tree: it is the
+framework's flagship model, bench target, and the exercise ground for
+TP/SP/PP/sharding.
+"""
+
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt3_tiny,
+    gpt3_125m,
+    gpt3_350m,
+    gpt3_1p3b,
+    gpt3_6p7b,
+    gpt3_13b,
+)
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaModel,
+    LlamaForCausalLM,
+    llama_tiny,
+    llama_7b,
+    llama_13b,
+)
+
+__all__ = [
+    "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+    "gpt3_tiny", "gpt3_125m", "gpt3_350m", "gpt3_1p3b", "gpt3_6p7b", "gpt3_13b",
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+    "llama_tiny", "llama_7b", "llama_13b",
+]

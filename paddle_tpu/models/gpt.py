@@ -1,0 +1,424 @@
+"""GPT-style decoder LM — the flagship training model.
+
+Reference analogs: fleet TP building blocks
+(python/paddle/distributed/fleet/layers/mpu/mp_layers.py:49,336,543,744),
+fused transformer kernels (paddle/phi/kernels/fusion/gpu/
+fused_multi_transformer_kernel.cu, fused_rope_kernel.cu,
+fused_layernorm_kernel.cu), flash attention
+(python/paddle/nn/functional/flash_attention.py:358).
+
+TPU-first design decisions:
+- One config drives both GPT-3 (pre-LN LayerNorm, GELU MLP, learned positions)
+  and LLaMA (RMSNorm, SwiGLU, RoPE, GQA) shapes.
+- All parallelism is expressed as sharding annotations: TP via
+  Column/RowParallelLinear dist_attr specs, SP/SEP via activation
+  constraints. The same model object runs single-chip or under a hybrid mesh
+  unchanged — GSPMD inserts the collectives the reference codes by hand.
+- Attention goes through F.scaled_dot_product_attention → Pallas flash
+  attention on TPU; everything else is left to XLA fusion (the epilogues the
+  reference hand-fuses are single jnp expressions here).
+- Static shapes throughout; the decode path keeps a static-capacity KV cache
+  updated with dynamic_update_slice (reference analog: paged/cached decode
+  attention masked_multihead_attention_kernel.cu) — no dynamic shapes under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Tensor, run_op
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    ParallelCrossEntropy,
+    mark_as_sequence_parallel,
+    _constrain,
+)
+from ..incubate.nn.functional import fused_rotary_position_embedding, swiglu
+
+__all__ = [
+    "GPTConfig",
+    "GPTModel",
+    "GPTForCausalLM",
+    "GPTPretrainingCriterion",
+    "gpt3_tiny",
+    "gpt3_125m",
+    "gpt3_350m",
+    "gpt3_1p3b",
+    "gpt3_6p7b",
+    "gpt3_13b",
+]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int | None = None  # GQA; None = MHA
+    intermediate_size: int | None = None  # None → 4h (gelu) or 8h/3 rounded (swiglu)
+    max_position_embeddings: int = 2048
+    norm_type: str = "layernorm"  # "layernorm" | "rmsnorm"
+    activation: str = "gelu"  # "gelu" | "swiglu"
+    use_rope: bool = False  # False → learned position embeddings
+    rope_theta: float = 10000.0
+    use_neox_rotary_style: bool = True
+    tie_word_embeddings: bool = True
+    hidden_dropout_prob: float = 0.0
+    attention_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    sequence_parallel: bool = False
+    use_recompute: bool = False
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        if self.activation == "swiglu":
+            # LLaMA sizing: 2/3 * 4h rounded up to a multiple of 256
+            return int(math.ceil(8 * self.hidden_size / 3 / 256) * 256)
+        return 4 * self.hidden_size
+
+    def num_params(self, include_embeddings=True):
+        h, L, V = self.hidden_size, self.num_layers, self.vocab_size
+        d = self.head_dim
+        attn = h * (self.num_heads * d) + 2 * h * (self.kv_heads * d) + (self.num_heads * d) * h
+        if self.activation == "swiglu":
+            mlp = 3 * h * self.ffn_size
+        else:
+            mlp = 2 * h * self.ffn_size
+        per_layer = attn + mlp + 2 * h
+        total = L * per_layer + h
+        if include_embeddings:
+            total += V * h
+            if not self.use_rope:
+                total += self.max_position_embeddings * h
+            if not self.tie_word_embeddings:
+                total += V * h
+        return total
+
+
+def _make_norm(config: GPTConfig):
+    if config.norm_type == "rmsnorm":
+        return nn.RMSNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+    return nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+
+def _init_attr(config: GPTConfig):
+    return nn.ParamAttr(initializer=I.Normal(mean=0.0, std=config.initializer_range))
+
+
+class GPTAttention(nn.Layer):
+    """Multi-head / grouped-query causal self-attention, TP-sharded on heads.
+
+    Reference: MultiHeadAttention (python/paddle/nn/layer/transformer.py) +
+    the fused path (fused_attention_kernel.cu / flash_attn_kernel.cu); TP
+    sharding as in mp_layers.py ColumnParallelLinear(gather_output=False) →
+    RowParallelLinear(input_is_parallel=True).
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h, d = config.hidden_size, config.head_dim
+        attr = _init_attr(config)
+        bias = config.norm_type == "layernorm"  # GPT has biases, LLaMA doesn't
+        self.q_proj = ColumnParallelLinear(h, config.num_heads * d, weight_attr=attr,
+                                           has_bias=bias, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, config.kv_heads * d, weight_attr=attr,
+                                           has_bias=bias, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, config.kv_heads * d, weight_attr=attr,
+                                           has_bias=bias, gather_output=False)
+        self.out_proj = RowParallelLinear(config.num_heads * d, h, weight_attr=attr,
+                                          has_bias=bias, input_is_parallel=True)
+
+    def forward(self, x, position_ids=None, cache=None, cache_offset=None):
+        cfg = self.config
+        B, S = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([B, S, cfg.num_heads, cfg.head_dim])
+        k = self.k_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        v = self.v_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        # keep heads sharded over mp between the projections
+        q = _constrain(q, P(None, None, "mp", None))
+        k = _constrain(k, P(None, None, "mp", None))
+        v = _constrain(v, P(None, None, "mp", None))
+        if cfg.use_rope:
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, position_ids=position_ids,
+                use_neox_rotary_style=cfg.use_neox_rotary_style,
+                rotary_emb_base=cfg.rope_theta,
+            )
+        new_cache = None
+        if cache is not None:
+            # static-capacity KV cache: cache.k/v are [B, S_max, Hkv, D]
+            k_all = run_op("kv_cache_update", _dyn_update, [cache[0], k, cache_offset])
+            v_all = run_op("kv_cache_update", _dyn_update, [cache[1], v, cache_offset])
+            new_cache = (k_all, v_all)
+            mask = _decode_mask(int(k_all.shape[1]), cache_offset, S)
+            out = F.scaled_dot_product_attention(
+                q, k_all, v_all, attn_mask=mask, is_causal=False,
+                dropout_p=cfg.attention_dropout_prob, training=self.training,
+            )
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=cfg.attention_dropout_prob, training=self.training,
+            )
+        out = out.reshape([B, S, cfg.num_heads * cfg.head_dim])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+def _dyn_update(buf, new, off):
+    """Write `new` [B,S,H,D] into static cache `buf` at sequence offset `off`."""
+    off = jnp.asarray(off).astype(jnp.int32).reshape(())
+    return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype), (0, off, 0, 0))
+
+
+def _decode_mask(s_max, offset, s_new):
+    """Bool mask [1,1,S_new,S_max]: position i (absolute off+i) attends to j<=off+i."""
+    def fn(off):
+        off = jnp.asarray(off).astype(jnp.int32).reshape(())
+        rows = off + jnp.arange(s_new)[:, None]
+        cols = jnp.arange(s_max)[None, :]
+        return (cols <= rows)[None, None]
+
+    return run_op("decode_mask", fn, [offset])
+
+
+class GPTMLP(nn.Layer):
+    """FFN: gelu 2-matmul or swiglu 3-matmul, TP column→row sharded
+    (reference: fused_feedforward_kernel.cu; swiglu.py:26)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, f = config.hidden_size, config.ffn_size
+        attr = _init_attr(config)
+        bias = config.norm_type == "layernorm"
+        self.activation = config.activation
+        if config.activation == "swiglu":
+            self.gate_proj = ColumnParallelLinear(h, f, weight_attr=attr, has_bias=bias,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, f, weight_attr=attr, has_bias=bias,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(f, h, weight_attr=attr, has_bias=bias,
+                                               input_is_parallel=True)
+        else:
+            self.fc1 = ColumnParallelLinear(h, f, weight_attr=attr, has_bias=bias,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(f, h, weight_attr=attr, has_bias=bias,
+                                         input_is_parallel=True)
+
+    def forward(self, x):
+        if self.activation == "swiglu":
+            return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-norm decoder block (reference: the block fused_multi_transformer
+    implements in one kernel, fused_multi_transformer_kernel.cu — here a
+    traceable composition XLA fuses)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = _make_norm(config)
+        self.self_attn = GPTAttention(config)
+        self.post_attention_layernorm = _make_norm(config)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, position_ids=None, cache=None, cache_offset=None):
+        residual = x
+        h = self.input_layernorm(x)
+        if cache is not None:
+            h, new_cache = self.self_attn(h, position_ids, cache, cache_offset)
+        else:
+            h = self.self_attn(h, position_ids)
+            new_cache = None
+        x = residual + self.dropout(h)
+        residual = x
+        h = self.mlp(self.post_attention_layernorm(x))
+        x = residual + self.dropout(h)
+        if self.config.sequence_parallel:
+            x = mark_as_sequence_parallel(x)
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class GPTModel(nn.Layer):
+    """Embeddings + decoder stack + final norm."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        attr = _init_attr(config)
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=attr
+        )
+        if not config.use_rope:
+            self.embed_positions = nn.Embedding(
+                config.max_position_embeddings, config.hidden_size, weight_attr=attr
+            )
+        self.embed_dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.layers = nn.LayerList([GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.final_norm = _make_norm(config)
+
+    def forward(self, input_ids, position_ids=None, caches=None, cache_offset=None):
+        B, S = input_ids.shape[0], input_ids.shape[1]
+        if position_ids is None:
+            if caches is not None and cache_offset is not None:
+                # decode default: absolute positions start at the cache offset
+                position_ids = run_op(
+                    "decode_positions",
+                    lambda off: jnp.broadcast_to(
+                        jnp.asarray(off).astype(jnp.int32).reshape(())
+                        + jnp.arange(S)[None, :],
+                        (B, S),
+                    ),
+                    [cache_offset],
+                )
+            else:
+                position_ids = Tensor(
+                    jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+                )
+        h = self.embed_tokens(input_ids)
+        if not self.config.use_rope:
+            h = h + self.embed_positions(position_ids)
+        h = self.embed_dropout(h)
+        if self.config.sequence_parallel:
+            h = mark_as_sequence_parallel(h)
+        new_caches = [] if caches is not None else None
+
+        def run_layer(layer, h, cache):
+            if cache is not None:
+                return layer(h, position_ids, cache, cache_offset)
+            return layer(h, position_ids)
+
+        for i, layer in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            if self.config.use_recompute and self.training and cache is None:
+                from ..distributed.fleet.recompute import recompute
+
+                h = recompute(layer, h, position_ids)
+            else:
+                out = run_layer(layer, h, cache)
+                if cache is not None:
+                    h, nc = out
+                    new_caches.append(nc)
+                else:
+                    h = out
+        h = self.final_norm(h)
+        if caches is not None:
+            return h, new_caches
+        return h
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head on top of GPTModel. Tied embeddings (GPT) share the
+    vocab-sharded embedding matrix; untied (LLaMA) use a vocab-sharded
+    ColumnParallelLinear. Logits stay vocab-sharded into the parallel
+    cross-entropy (reference: mp_layers.py:744 ParallelCrossEntropy)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=_init_attr(config), has_bias=False, gather_output=False,
+            )
+
+    def forward(self, input_ids, position_ids=None, caches=None, cache_offset=None):
+        out = self.gpt(input_ids, position_ids, caches, cache_offset)
+        if caches is not None:
+            h, new_caches = out
+        else:
+            h = out
+        if self.config.tie_word_embeddings:
+            w = self.gpt.embed_tokens.weight
+            logits = run_op("lm_head_tied", lambda a, ww: jnp.matmul(a, ww.T), [h, w])
+            logits = _constrain(logits, P(None, None, "mp"))
+        else:
+            logits = self.lm_head(h)
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def init_kv_caches(self, batch_size, max_seq_len, dtype="float32"):
+        """Static-capacity decode caches, one (k, v) pair per layer."""
+        cfg = self.config
+        shape = (batch_size, max_seq_len, cfg.kv_heads, cfg.head_dim)
+        return [
+            (Tensor(jnp.zeros(shape, jnp.dtype(dtype))), Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
+            for _ in range(cfg.num_layers)
+        ]
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Masked next-token cross entropy over (possibly vocab-sharded) logits."""
+
+    def __init__(self, config: GPTConfig = None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        losses = self.ce(logits, labels)  # [B, S]
+        if loss_mask is not None:
+            m = loss_mask.reshape(losses.shape).astype("float32")
+            return (losses.astype("float32") * m).sum() / m.sum().clip(min=1.0)
+        return losses.mean()
+
+
+# ----------------------------------------------------------------------- #
+# presets (sizes per GPT-3 paper table 2.1 — the BASELINE.md configs)
+# ----------------------------------------------------------------------- #
+
+
+def gpt3_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                     max_position_embeddings=128, **kw)
+
+
+def gpt3_125m(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt3_350m(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt3_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+
+
+def gpt3_6p7b(**kw):
+    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32, **kw)
+
+
+def gpt3_13b(**kw):
+    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40, **kw)

@@ -32,6 +32,10 @@ _USE_PALLAS = True
 def _use_pallas_kernel():
     if not _USE_PALLAS:
         return False
+    from ...ops.pallas import interpret_mode
+
+    if interpret_mode():
+        return True
     try:
         import jax
 

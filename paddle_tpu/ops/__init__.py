@@ -1,0 +1,2 @@
+"""TPU op library: Pallas kernels for the reference's hand-written CUDA
+fusion kernels (SURVEY §2.2), plus jnp fallbacks for CPU testing."""

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels.
+
+Each module provides a jittable, differentiable entry point plus an
+`interpret` escape hatch (PADDLE_TPU_PALLAS_INTERPRET=1) so the kernels run —
+and are tested — on CPU through the Pallas interpreter, the analog of the
+reference testing CUDA kernels against NumPy oracles (test/legacy_test/op_test.py).
+"""
+
+import os
+
+
+def interpret_mode() -> bool:
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"

@@ -1,0 +1,388 @@
+"""Flash attention for TPU, in Pallas.
+
+Reference analog: the dynloaded flash-attention library the reference wraps
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu:832, dynload
+paddle/phi/backends/dynload/flashattn.cc) — re-designed for the TPU memory
+hierarchy rather than translated:
+
+- grid (batch, heads, q-blocks, kv-blocks), kv innermost: the online-softmax
+  running state (m, l, acc) lives in VMEM scratch that persists across the
+  sequential TPU grid steps, so no atomics / split-k reduction pass is needed.
+- blocks sized to the MXU (128x128 default), logits computed f32 via
+  preferred_element_type, inputs can be bf16.
+- causal blocks strictly above the diagonal are skipped via pl.when
+  (no wasted MXU work), diagonal blocks are masked with broadcasted_iota.
+- GQA/MQA: kv heads indexed via the BlockSpec index_map (no head repetition
+  materialized in the forward).
+- backward = two kernels (dq; dk/dv) recomputing logits from the saved
+  softmax LSE — the standard recompute-not-store flash backward, wired as
+  jax.custom_vjp.
+
+All entry points pad the sequence to block multiples and mask the padding, so
+any length works with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import interpret_mode
+
+__all__ = ["flash_attention_fwd", "flash_attention"]
+
+NEG_INF = -1e30
+
+
+def _block_sizes(sq, skv):
+    bq = min(128, max(8, sq))
+    bk = min(128, max(8, skv))
+    return bq, bk
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, causal, sq, skv, bq, bk, nk):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    q_start = i * bq
+    k_start = j * bk
+    # bottom-right-aligned causal (flash-attn convention): query at true row r
+    # attends to cols <= r + (skv - sq), so decode (sq=1) sees the whole cache
+    off = skv - sq
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: block needed iff k_start <= q_end + off
+    needed = True
+    if causal:
+        needed = k_start <= q_start + bq - 1 + off
+
+    @pl.when(needed if causal else j >= 0)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+
+        row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        col = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < skv  # kv padding
+        if causal:
+            mask = mask & (col <= row + off)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0, 0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # last block for this row: nk-1 in general; for causal the last needed one
+    if causal:
+        last = jnp.clip((q_start + bq - 1 + off) // bk, 0, nk - 1)
+    else:
+        last = nk - 1
+
+    @pl.when(j == last)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
+
+
+def _fwd(q, k, v, scale, causal, sq, skv):
+    B, H, Sqp, D = q.shape
+    _, Hkv, Skvp, _ = k.shape
+    bq, bk = _block_sizes(Sqp, Skvp)
+    nq = Sqp // bq
+    nk = Skvp // bk
+    group = H // Hkv
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, sq=sq, skv=skv,
+        bq=bq, bk=bk, nk=nk,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sqp, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sqp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------- #
+# backward
+# --------------------------------------------------------------------------- #
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, sq, skv, bq, bk, nk):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    q_start = i * bq
+    k_start = j * bk
+    off = skv - sq
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = k_start <= q_start + bq - 1 + off if causal else j >= 0
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]  # [bq, 1]
+        delta = delta_ref[0, 0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        col = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < skv
+        if causal:
+            mask = mask & (col <= row + off)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        last = jnp.clip((q_start + bq - 1 + off) // bk, 0, nk - 1)
+    else:
+        last = nk - 1
+
+    @pl.when(j == last)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, sq, skv, bq, bk, nq):
+    j = pl.program_id(2)  # kv block
+    i = pl.program_id(3)  # q block
+    q_start = i * bq
+    k_start = j * bk
+    off = skv - sq
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: q block needed iff q_end + off >= k_start
+    needed = q_start + bq - 1 + off >= k_start if causal else i >= 0
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        col = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (col < skv) & (row < sq)
+        if causal:
+            mask = mask & (col <= row + off)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale  # [bq, bk]
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, sq, skv, residuals, dout):
+    q, k, v, out, lse = residuals
+    B, H, Sqp, D = q.shape
+    _, Hkv, Skvp, _ = k.shape
+    bq, bk = _block_sizes(Sqp, Skvp)
+    nq = Sqp // bq
+    nk = Skvp // bk
+    group = H // Hkv
+
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          sq=sq, skv=skv, bq=bq, bk=bk, nk=nk),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sqp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret_mode(),
+    )(q, k, v, dout, lse, delta)
+
+    # dk/dv over expanded heads, then group-sum for GQA
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          sq=sq, skv=skv, bq=bq, bk=bk, nq=nq),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Skvp, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Skvp, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(q, k, v, dout, lse, delta)
+
+    if group > 1:
+        dk = dk.reshape(B, Hkv, group, Skvp, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, group, Skvp, D).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# --------------------------------------------------------------------------- #
+# public entry: [B, S, H, D] paddle layout, custom VJP
+# --------------------------------------------------------------------------- #
+
+
+def _pad_seq(x, block):
+    s = x.shape[2]
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    out, _ = _flash_fwd_res(q, k, v, causal, scale)
+    return out
+
+
+def _flash_fwd_res(q, k, v, causal, scale):
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    bq, bk = _block_sizes(Sq, Skv)
+    qp = _pad_seq(q, bq)
+    kp = _pad_seq(k, bk)
+    vp = _pad_seq(v, bk)
+    out, lse = _fwd(qp, kp, vp, scale, causal, Sq, Skv)
+    return out[:, :, :Sq], (qp, kp, vp, out, lse)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale):
+    out, res = _flash_fwd_res(q, k, v, causal, scale)
+    return out, (res, q.shape[2], k.shape[2])
+
+
+def _flash_vjp_bwd(causal, scale, saved, dout):
+    (qp, kp, vp, outp, lse), sq, skv = saved
+    bq, _ = _block_sizes(qp.shape[2], kp.shape[2])
+    dop = jnp.pad(dout, ((0, 0), (0, 0), (0, qp.shape[2] - sq), (0, 0)))
+    dq, dk, dv = _bwd(scale, causal, sq, skv, (qp, kp, vp, outp, lse), dop)
+    return dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv]
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """Paddle-layout entry: q [B,Sq,H,D], k/v [B,Skv,Hkv,D] → [B,Sq,H,D].
+
+    Differentiable (custom VJP, flash backward). Reference API:
+    python/paddle/nn/functional/flash_attention.py:358."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+flash_attention = flash_attention_fwd

@@ -302,3 +302,54 @@ class TestDataParallel:
         assert out.shape == [2, 2]
         assert len(dp.parameters()) == 2
         assert "weight" in dict(dp.named_parameters())
+
+
+class TestCollectiveRegressions:
+    """Fixes from review: p2p mailbox routing, alltoall_single transpose,
+    reduce_scatter non-SUM axis, fused dp-sep group."""
+
+    def test_send_recv_nonzero_dst(self):
+        import paddle_tpu.distributed as dist
+
+        g = dist.new_group(list(range(4)))
+        t = paddle.to_tensor(np.arange(4, dtype="float32"))
+        dist.send(t, dst=1, group=g)
+        out = paddle.zeros([4])
+        dist.recv(out, src=0, group=g)
+        np.testing.assert_allclose(out.numpy(), t.numpy())
+
+    def test_alltoall_single_transpose(self):
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        n = dist.get_world_size()
+        g = dist.new_group(list(range(2)))
+        # stacked [src=2, dst=2, per=1] rows: a0 b0 / a1 b1 -> a0 a1 / b0 b1
+        src = paddle.to_tensor(np.array([[0.0], [1.0], [2.0], [3.0]], "float32"))
+        out = paddle.zeros([4, 1])
+        dist.alltoall_single(out, src, group=g)
+        np.testing.assert_allclose(out.numpy().ravel(), [0.0, 2.0, 1.0, 3.0])
+
+    def test_reduce_scatter_max(self):
+        import paddle_tpu.distributed as dist
+
+        g = dist.new_group(list(range(2)))
+        # entry j = per-source contributions for destination j
+        t0 = paddle.to_tensor(np.array([[1.0], [8.0]], "float32"))
+        t1 = paddle.to_tensor(np.array([[3.0], [2.0]], "float32"))
+        out = paddle.zeros([2, 1])
+        dist.reduce_scatter(out, [t0, t1], op=dist.ReduceOp.MAX, group=g)
+        np.testing.assert_allclose(out.numpy().ravel(), [8.0, 3.0])
+
+    def test_dp_sep_group_ranks(self):
+        from paddle_tpu.distributed.fleet.base.topology import (
+            CommunicateTopology,
+            HybridCommunicateGroup,
+        )
+
+        topo = CommunicateTopology(dims=(2, 1, 1, 2, 2))  # dp=2, sep=2, mp=2
+        hcg = HybridCommunicateGroup(topo)
+        # rank 0's dp-sep peers: all ranks with the same mp coordinate
+        ranks = hcg.get_dp_sep_parallel_group().ranks
+        assert len(ranks) == 4
+        assert 0 in ranks

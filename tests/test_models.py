@@ -1,0 +1,217 @@
+"""Flagship decoder LM tests: GPT/LLaMA variants, fused incubate ops,
+hybrid-parallel parity, decode cache (reference test model:
+test/collective/fleet/hybrid_parallel_mp_model.py — parallel-vs-single
+numeric parity as the oracle)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.optimizer as opt
+from paddle_tpu import nn
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.models import (
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt3_tiny,
+    gpt3_1p3b,
+    llama_tiny,
+    llama_7b,
+)
+
+
+class TestIncubateFunctional:
+    def test_swiglu(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        out = IF.swiglu(x, y)
+        ref = (x.numpy() / (1 + np.exp(-x.numpy()))) * y.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        # single-input form splits in half
+        out2 = IF.swiglu(paddle.to_tensor(np.concatenate([x.numpy(), y.numpy()], -1)))
+        np.testing.assert_allclose(out2.numpy(), ref, rtol=1e-5)
+
+    def test_fused_rms_norm_residual(self):
+        x = np.random.randn(2, 4, 8).astype("float32")
+        r = np.random.randn(2, 4, 8).astype("float32")
+        w = np.random.rand(8).astype("float32") + 0.5
+        out, res = IF.fused_rms_norm(
+            paddle.to_tensor(x), paddle.to_tensor(w), residual=paddle.to_tensor(r)
+        )
+        h = x + r
+        ref = h / np.sqrt((h ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(res.numpy(), h, rtol=1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_layer_norm(self):
+        x = np.random.randn(2, 6, 8).astype("float32")
+        w = np.random.rand(8).astype("float32") + 0.5
+        b = np.random.randn(8).astype("float32")
+        out, _ = IF.fused_layer_norm(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_rope_roundtrip_neox_vs_gptj(self):
+        q = np.random.randn(2, 6, 4, 8).astype("float32")
+        for neox in (True, False):
+            out, _, _ = IF.fused_rotary_position_embedding(
+                paddle.to_tensor(q), use_neox_rotary_style=neox
+            )
+            assert out.shape == [2, 6, 4, 8]
+            # position 0 is identity rotation
+            np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-5, atol=1e-6)
+            # norms preserved (rotation)
+            np.testing.assert_allclose(
+                np.linalg.norm(out.numpy(), axis=-1), np.linalg.norm(q, axis=-1),
+                rtol=1e-4,
+            )
+
+    def test_fused_rope_position_ids(self):
+        q = np.random.randn(1, 4, 2, 8).astype("float32")
+        pid = np.array([[0, 1, 2, 3]])
+        out1, _, _ = IF.fused_rotary_position_embedding(paddle.to_tensor(q))
+        out2, _, _ = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), position_ids=paddle.to_tensor(pid)
+        )
+        np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-5)
+
+    def test_fused_bias_act(self):
+        x = np.random.randn(3, 8).astype("float32")
+        b = np.random.randn(8).astype("float32")
+        out = IF.fused_bias_act(paddle.to_tensor(x), paddle.to_tensor(b), act_method="relu")
+        np.testing.assert_allclose(out.numpy(), np.maximum(x + b, 0), rtol=1e-6)
+        out2 = IF.fused_bias_act(paddle.to_tensor(x), act_method="swiglu")
+        assert out2.shape == [3, 4]
+
+    def test_fused_dropout_add(self):
+        x = np.random.randn(4, 8).astype("float32")
+        y = np.random.randn(4, 8).astype("float32")
+        out = IF.fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y), p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+
+
+class TestGPTModel:
+    def test_forward_backward_gpt(self):
+        paddle.seed(0)
+        cfg = gpt3_tiny()
+        m = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+        labels = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+        logits = m(ids)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+        loss = crit(logits, labels)
+        loss.backward()
+        assert m.gpt.embed_tokens.weight.grad is not None
+        assert np.isfinite(float(loss))
+
+    def test_forward_backward_llama_gqa(self):
+        paddle.seed(0)
+        cfg = llama_tiny()
+        assert cfg.kv_heads == 2 and cfg.num_heads == 4
+        m = GPTForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 8)))
+        logits = m(ids)
+        loss = logits.mean()
+        loss.backward()
+        assert m.lm_head.weight.grad is not None
+
+    def test_loss_mask(self):
+        paddle.seed(0)
+        cfg = gpt3_tiny()
+        m = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 8)))
+        labels = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 8)))
+        mask = np.ones((2, 8), "float32")
+        mask[:, 4:] = 0
+        l1 = crit(m(ids), labels, paddle.to_tensor(mask))
+        assert np.isfinite(float(l1))
+
+    def test_decode_cache_matches_full_forward(self):
+        """Prefill+decode through the static KV cache == full causal forward."""
+        paddle.seed(0)
+        cfg = llama_tiny()
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids_np = np.random.randint(0, cfg.vocab_size, (1, 8))
+        full = m(paddle.to_tensor(ids_np)).numpy()
+
+        caches = m.init_kv_caches(1, 16)
+        # prefill first 4 (position_ids default derives from cache_offset)
+        lg, caches = m(paddle.to_tensor(ids_np[:, :4]),
+                       caches=caches, cache_offset=paddle.to_tensor(0))
+        np.testing.assert_allclose(lg.numpy(), full[:, :4], rtol=1e-4, atol=1e-4)
+        # decode one token at a time
+        for t in range(4, 8):
+            lg, caches = m(paddle.to_tensor(ids_np[:, t:t + 1]),
+                           caches=caches, cache_offset=paddle.to_tensor(t))
+            np.testing.assert_allclose(lg.numpy()[:, 0], full[:, t], rtol=1e-4, atol=1e-4)
+
+    def test_param_counts(self):
+        assert abs(gpt3_1p3b().num_params() / 1e9 - 1.3) < 0.1
+        assert abs(llama_7b().num_params() / 1e9 - 6.74) < 0.15
+
+
+class TestGPTHybridParallel:
+    def _build(self, seed, sp=False):
+        paddle.seed(seed)
+        cfg = gpt3_tiny(sequence_parallel=sp)
+        m = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        return m, crit, o
+
+    def test_hybrid_parity_dp_sharding_mp(self):
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 1024, (8, 16))
+        labels = rng.integers(0, 1024, (8, 16))
+
+        m1, c1, o1 = self._build(7)
+        step1 = dist.DistributedTrainStep(m1, lambda lg, lb: c1(lg, lb), o1,
+                                          mesh=dist.build_mesh())
+        l1 = [float(step1(paddle.to_tensor(ids), paddle.to_tensor(labels)))
+              for _ in range(3)]
+
+        m2, c2, o2 = self._build(7, sp=True)
+        mesh = dist.build_mesh(dp=2, sharding=2, mp=2)
+        step2 = dist.DistributedTrainStep(m2, lambda lg, lb: c2(lg, lb), o2,
+                                          mesh=mesh, sharding_stage=1)
+        l2 = [float(step2(paddle.to_tensor(ids), paddle.to_tensor(labels)))
+              for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-5)
+
+    def test_mp_weight_shardings_applied(self):
+        paddle.seed(0)
+        dist.build_mesh(mp=4)
+        cfg = gpt3_tiny()
+        m = GPTForCausalLM(cfg)
+        from jax.sharding import PartitionSpec as P
+
+        attn = m.gpt.layers[0].self_attn
+        assert attn.q_proj.weight.dist_attr == P(None, "mp")
+        assert attn.out_proj.weight.dist_attr == P("mp", None)
+        assert m.gpt.embed_tokens.weight.dist_attr == P("mp", None)
+        dist.build_mesh()  # reset
+
+
+class TestGraftEntry:
+    def test_entry_jittable(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "__graft_entry__",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "__graft_entry__.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        import jax
+
+        fn, args = mod.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (2, 16, 1024)
+        mod.dryrun_multichip(8)
