@@ -42,7 +42,15 @@ def _mp_degree():
 
 
 def _constrain(x: Tensor, spec: P) -> Tensor:
-    """with_sharding_constraint when inside a jit over the global mesh."""
+    """with_sharding_constraint when inside a jit over the global mesh.
+
+    Inside a partial-manual shard_map region (the compiled pp pipeline,
+    paddle_tpu/parallel/pipeline.py), the constraint must be built on the
+    CONTEXT abstract mesh (whose pp axis is Manual) — a sharding carrying the
+    concrete all-Auto mesh poisons downstream op types. Axes that are manual
+    in context are dropped from the spec: the region is already
+    device-local over them.
+    """
     mesh = _env.get_global_mesh()
     if mesh is None:
         return x
@@ -51,6 +59,22 @@ def _constrain(x: Tensor, spec: P) -> Tensor:
         import jax
 
         try:
+            ctx = jax.sharding.get_abstract_mesh()
+            if ctx is not None and not ctx.empty and ctx.manual_axes:
+                manual = set(ctx.manual_axes)
+
+                def strip(entry):
+                    if entry is None:
+                        return None
+                    if isinstance(entry, tuple):
+                        kept = tuple(e for e in entry if e not in manual)
+                        return kept if kept else None
+                    return None if entry in manual else entry
+
+                spec2 = P(*[strip(s) for s in spec])
+                return jax.lax.with_sharding_constraint(
+                    a, jax.sharding.NamedSharding(ctx, spec2)
+                )
             return jax.lax.with_sharding_constraint(
                 a, jax.sharding.NamedSharding(mesh, spec)
             )

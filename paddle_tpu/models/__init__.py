@@ -20,6 +20,11 @@ from .gpt import (  # noqa: F401
     gpt3_6p7b,
     gpt3_13b,
 )
+from .gpt_pipe import (  # noqa: F401
+    GPTForCausalLMPipe,
+    stack_layered_state_dict,
+    unstack_to_layered_state_dict,
+)
 from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaModel,
@@ -32,6 +37,7 @@ from .llama import (  # noqa: F401
 __all__ = [
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
     "gpt3_tiny", "gpt3_125m", "gpt3_350m", "gpt3_1p3b", "gpt3_6p7b", "gpt3_13b",
+    "GPTForCausalLMPipe", "stack_layered_state_dict", "unstack_to_layered_state_dict",
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "llama_tiny", "llama_7b", "llama_13b",
 ]

@@ -1,0 +1,266 @@
+"""Pipelined flagship GPT: stacked decoder params + compiled pp schedule.
+
+Reference analog: GPT-style models built from PipelineLayer/LayerDesc and
+driven by the 1F1B runtime (python/paddle/distributed/fleet/meta_parallel/
+pp_layers.py:258, pipeline_parallel.py:684). There the pipeline is a process
+schedule; here it is program structure:
+
+- All L decoder layers' parameters live in STACKED arrays with leading dim L
+  (flax scan-over-layers style). Benefits on TPU: one compiled layer body
+  regardless of depth (compile time O(1) in L), and the leading dim is the
+  natural pp shard axis.
+- pp=1: the decoder stack is a lax.scan over the leading dim.
+- pp>1: the stack reshapes to [S, L/S, ...] and runs through
+  paddle_tpu.parallel.pipeline_spmd — microbatches ride a ppermute ring over
+  the `pp` mesh axis while TP/DP/sharding stay GSPMD auto axes inside each
+  stage.
+
+The per-layer compute is the SAME GPTDecoderLayer as models/gpt.py (TP
+sharding constraints included) executed through jit.functional_call against
+a parameter-less template — so single-chip GPT and pipelined GPT cannot
+drift apart numerically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Parameter, Tensor, run_op
+from .. import nn
+from ..nn import initializer as I
+from ..distributed import env as _env
+from ..distributed.fleet.layers.mpu.mp_layers import (
+    VocabParallelEmbedding,
+    ColumnParallelLinear,
+    _constrain,
+)
+from ..parallel.pipeline import (
+    microbatch,
+    pipeline_spmd,
+    unmicrobatch,
+)
+from .gpt import GPTConfig, GPTDecoderLayer, _init_attr, _make_norm
+
+__all__ = ["GPTForCausalLMPipe", "stack_layered_state_dict", "unstack_to_layered_state_dict"]
+
+
+def _stacked_name(template_name: str) -> str:
+    return "stack__" + template_name.replace(".", "__")
+
+
+class GPTForCausalLMPipe(nn.Layer):
+    """GPT/LLaMA causal LM with stacked decoder params and a compiled
+    pipeline schedule over the mesh's `pp` axis.
+
+    num_microbatches: microbatch count M for the pipeline (reference
+    accumulate_steps, pipeline_parallel.py:940). Ignored when pp == 1.
+    """
+
+    def __init__(self, config: GPTConfig, num_microbatches: int = 4):
+        super().__init__()
+        self.config = config
+        self.num_microbatches = num_microbatches
+        attr = _init_attr(config)
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=attr
+        )
+        if not config.use_rope:
+            self.embed_positions = nn.Embedding(
+                config.max_position_embeddings, config.hidden_size, weight_attr=attr
+            )
+        self.embed_dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.final_norm = _make_norm(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=attr, has_bias=False, gather_output=False,
+            )
+
+        # template holds the layer STRUCTURE; its own params are never used
+        # (functional_call swaps in slices of the stacked params). Stored
+        # outside the Layer registry so it contributes nothing to
+        # parameters()/state_dict().
+        template = GPTDecoderLayer(config)
+        template.eval()
+        object.__setattr__(self, "_template", template)
+        self._param_names = [n for n, _ in template.named_parameters()]
+
+        # inherit exact per-layer init distributions by building ONE layer at
+        # a time into preallocated host buffers (peak memory = stacked params
+        # + a single layer, not 2x the decoder)
+        import numpy as np
+
+        L = config.num_layers
+        bufs = {}
+        for i in range(L):
+            layer = template if i == 0 else GPTDecoderLayer(config)
+            named = dict(layer.named_parameters())
+            for tname in self._param_names:
+                v = np.asarray(named[tname]._value)
+                if tname not in bufs:
+                    bufs[tname] = np.empty((L,) + v.shape, v.dtype)
+                bufs[tname][i] = v
+            del layer, named
+        tparams = dict(template.named_parameters())
+        for tname in self._param_names:
+            tparam = tparams[tname]
+            stacked = Parameter(jnp.asarray(bufs.pop(tname)), name=_stacked_name(tname))
+            tspec = tuple(tparam.dist_attr) if tparam.dist_attr is not None else ()
+            pad = (None,) * (stacked._value.ndim - 1 - len(tspec))
+            stacked.dist_attr = P("pp", *(tuple(tspec) + pad))
+            self.add_parameter(_stacked_name(tname), stacked)
+
+    # ------------------------------------------------------------------ #
+
+    def _stacked_tensors(self):
+        return [getattr(self, _stacked_name(n)) for n in self._param_names]
+
+    def _layer_fn(self, training):
+        """Single decoder-layer apply through the template (memoized so the
+        compiled-pipeline cache in parallel.pipeline keys on a stable fn)."""
+        cached = self.__dict__.setdefault("_layer_fn_cache", {})
+        if training not in cached:
+            template = self._template
+            names = self._param_names
+
+            from ..jit import functional_call
+
+            def layer_fn(pslice, hh, pos, key):
+                out, _ = functional_call(
+                    template, dict(zip(names, pslice)), {},
+                    [Tensor(hh), Tensor(pos)], train=training, rng_key=key,
+                )
+                return out
+
+            cached[training] = layer_fn
+        return cached[training]
+
+    def _stage_fn(self, training, lps):
+        """Stage body for the compiled pipeline: scan lps layers, each with
+        its own dropout key folded with the microbatch index."""
+        cached = self.__dict__.setdefault("_stage_fn_cache", {})
+        k = (training, lps)
+        if k not in cached:
+            layer_fn = self._layer_fn(training)
+
+            def stage_fn(pstage, inp):
+                hh, pos, mb_idx = inp
+                params, keys = pstage
+
+                def scan_body(carry, x):
+                    pslice, key = x
+                    key = jax.random.fold_in(key, mb_idx[0])
+                    return layer_fn(pslice, carry, pos, key), None
+
+                hh, _ = jax.lax.scan(scan_body, hh, (params, keys))
+                return (hh, pos, mb_idx)
+
+            cached[k] = stage_fn
+        return cached[k]
+
+    def forward(self, input_ids, position_ids=None):
+        cfg = self.config
+        B, S = input_ids.shape[0], input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.broadcast_to(jnp.arange(S)[None, :], (B, S)))
+        h = self.embed_tokens(input_ids)
+        if not cfg.use_rope:
+            h = h + self.embed_positions(position_ids)
+        h = self.embed_dropout(h)
+
+        training = self.training
+        mesh = _env.get_global_mesh()
+        pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        M = self.num_microbatches
+        L = cfg.num_layers
+        layer_fn = self._layer_fn(training)
+        stage_fn = self._stage_fn(training, L // pp if pp > 1 else L)
+        from ..framework import random as rnd
+
+        def decoder_stack(h_raw, pos_raw, *stacked_raw):
+            # one dropout key per layer (reference: TP-aware RNG tracker,
+            # fleet/layers/mpu/random.py); pipeline path additionally folds
+            # in the microbatch index so ticks decorrelate
+            base_key = rnd.next_key()
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                jnp.arange(L)
+            )
+            stacked = list(stacked_raw)
+            if pp > 1:
+                if L % pp != 0:
+                    raise ValueError(f"num_layers {L} not divisible by pp {pp}")
+                lps = L // pp
+                staged = [a.reshape((pp, lps) + a.shape[1:]) for a in stacked]
+                keys_staged = keys.reshape((pp, lps) + keys.shape[1:])
+
+                mb = h_raw.shape[0] // M
+                mb_idx = jnp.repeat(jnp.arange(M, dtype=jnp.int32), mb)
+                inp_mb = microbatch((h_raw, pos_raw, mb_idx), M)
+                out_mb = pipeline_spmd(
+                    stage_fn, (staged, keys_staged), inp_mb,
+                    mesh=mesh, axis="pp", remat=True,
+                )
+                out, _, _ = unmicrobatch(out_mb)
+                return out
+
+            def scan_body(carry, x):
+                pslice, key = x
+                return layer_fn(pslice, carry, pos_raw, key), None
+
+            out, _ = jax.lax.scan(scan_body, h_raw, (stacked, keys))
+            return out
+
+        h = run_op("decoder_stack_pipeline", decoder_stack,
+                   [h, position_ids] + self._stacked_tensors())
+        h = self.final_norm(h)
+        if cfg.tie_word_embeddings:
+            w = self.embed_tokens.weight
+            logits = run_op("lm_head_tied", lambda a, ww: jnp.matmul(a, ww.T), [h, w])
+            logits = _constrain(logits, P(None, None, "mp"))
+        else:
+            logits = self.lm_head(h)
+        return logits
+
+
+# ------------------------------------------------------------------------- #
+# state-dict interop with the layered GPTForCausalLM
+# ------------------------------------------------------------------------- #
+
+
+def stack_layered_state_dict(layered: dict, num_layers: int) -> dict:
+    """Convert a GPTForCausalLM state_dict (gpt.layers.<i>.<p> keys) to the
+    pipe model's layout (stack__<p> keys + shared embed/norm/head keys)."""
+    out = {}
+    per_layer: dict[str, list] = {}
+    for k, v in layered.items():
+        if k.startswith("gpt.layers."):
+            rest = k[len("gpt.layers."):]
+            idx, pname = rest.split(".", 1)
+            per_layer.setdefault(pname, [None] * num_layers)[int(idx)] = v
+        elif k.startswith("gpt."):
+            out[k[len("gpt."):]] = v
+        else:
+            out[k] = v
+    for pname, vals in per_layer.items():
+        if any(v is None for v in vals):
+            raise ValueError(f"missing layers for {pname}")
+        out[_stacked_name(pname)] = Tensor(
+            jnp.stack([v._value if isinstance(v, Tensor) else jnp.asarray(v) for v in vals])
+        )
+    return out
+
+
+def unstack_to_layered_state_dict(pipe_sd: dict, num_layers: int) -> dict:
+    """Inverse of stack_layered_state_dict."""
+    out = {}
+    for k, v in pipe_sd.items():
+        if k.startswith("stack__"):
+            pname = k[len("stack__"):].replace("__", ".")
+            arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            for i in range(num_layers):
+                out[f"gpt.layers.{i}.{pname}"] = Tensor(arr[i])
+        else:
+            out["gpt." + k if not k.startswith("lm_head") else k] = v
+    return out

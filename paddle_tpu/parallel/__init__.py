@@ -1,0 +1,11 @@
+"""Compiled SPMD parallelism primitives.
+
+This package holds the schedules that don't fall out of plain GSPMD
+annotation — pipeline parallelism (collective-permute microbatch loop) and
+ring attention (paddle_tpu.parallel.ring) — expressed as shard_map programs
+over the hybrid mesh built by paddle_tpu.distributed.env.build_mesh.
+"""
+
+from .pipeline import pipeline_spmd, stack_pytrees, unstack_leading
+
+__all__ = ["pipeline_spmd", "stack_pytrees", "unstack_leading"]
