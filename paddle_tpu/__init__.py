@@ -107,3 +107,4 @@ from . import hapi  # noqa: E402
 from .hapi import Model, summary  # noqa: E402
 from . import distributed  # noqa: E402
 from .distributed import DataParallel  # noqa: E402
+from . import incubate  # noqa: E402

@@ -1,0 +1,40 @@
+"""MoE collective helpers — global_scatter / global_gather.
+
+Reference: python/paddle/distributed/utils/moe_utils.py — ragged NCCL
+all-to-alls moving count-prefixed token buffers between expert-parallel ranks.
+
+TPU-native stance: the MoE layer (incubate/distributed/models/moe) routes with
+dense dispatch/combine einsums whose sharding constraints compile to XLA
+all-to-alls, so ragged runtime exchanges are unnecessary on the hot path.
+These functions exist for API parity: they implement the same global
+(src, expert)-grid transpose on the capacity-padded static layout.
+
+Layout contract (static-shape analog of the reference's count arrays):
+`x` is [num_ranks * num_local_expert * capacity, d_model] — rank-major rows,
+i.e. row block (r, e) holds the tokens this rank routes to global expert
+r * num_local_expert + e, padded to `capacity`.
+"""
+
+from __future__ import annotations
+
+from ..collective import _grp, alltoall_single
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Exchange token blocks so each rank receives the tokens routed to its
+    local experts (reference: moe_utils.py global_scatter). With the static
+    capacity-padded layout the exchange is exactly one equal-split all-to-all;
+    `local_count`/`global_count` are accepted for signature parity (counts are
+    implied by the padded layout)."""
+    out = x.clone() if hasattr(x, "clone") else x
+    alltoall_single(out, x, group=group)
+    return out
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter (reference: moe_utils.py global_gather) —
+    returns expert outputs to the ranks that own the tokens. The equal-split
+    all-to-all is self-inverse on the (src, dst) chunk grid."""
+    out = x.clone() if hasattr(x, "clone") else x
+    alltoall_single(out, x, group=group)
+    return out

@@ -4,3 +4,5 @@
 from . import nn  # noqa: F401
 
 __all__ = ["nn"]
+from . import distributed  # noqa: F401
+__all__.append("distributed")

@@ -1,0 +1,4 @@
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import ExpertFFN, MoELayer  # noqa: F401
+
+__all__ = ["BaseGate", "GShardGate", "NaiveGate", "SwitchGate", "ExpertFFN", "MoELayer"]

@@ -1,0 +1,168 @@
+"""MoE gates.
+
+Reference: python/paddle/incubate/distributed/models/moe/gate/
+{base_gate,naive_gate,gshard_gate,switch_gate}.py — linear router producing
+per-token expert scores, top-k selection, capacity enforcement and the GShard
+load-balancing auxiliary loss.
+
+TPU-native redesign: gates return dense dispatch/combine tensors
+([S, E, C] einsum operands) instead of index lists — index-free routing keeps
+everything static-shaped for XLA and feeds the MXU directly (this is the
+original GShard-on-TPU formulation). The auxiliary loss is stored on the gate
+(`gate.l_aux`) exactly like the reference's BaseGate.set_loss/get_loss.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from .....framework import random as rnd
+from .....framework.core import run_op
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+def _topk_dispatch(probs, k, capacity, normalize_topk):
+    """Dense top-k routing with capacity.
+
+    probs: [S, E] router probabilities. Returns (combine [S,E,C],
+    dispatch [S,E,C] 0/1, l_aux scalar). Tokens overflowing an expert's
+    capacity are dropped (zero rows — same semantics as the reference's
+    capacity pruning in gshard_gate.py).
+    """
+    S, E = probs.shape
+    topv, topi = jax.lax.top_k(probs, k)  # [S, k]
+    if normalize_topk:
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(topi, E, dtype=probs.dtype)  # [S, k, E]
+
+    # load-balancing aux loss (GShard eq.4): E * sum_e mean_prob_e * frac_top1_e
+    me = probs.mean(0)                                   # [E]
+    ce = onehot[:, 0, :].mean(0)                         # fraction routed (1st choice)
+    l_aux = (me * ce).sum() * E
+
+    # choice-major priority: all 1st choices rank before any 2nd choice
+    m = jnp.transpose(onehot, (1, 0, 2)).reshape(k * S, E)
+    pos_before = jnp.cumsum(m, axis=0) - m               # tokens ahead, [k*S, E]
+    pos = (pos_before * m).sum(-1)                       # scalar slot per (choice, token)
+    keep = (pos < capacity) & (m.sum(-1) > 0)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=probs.dtype) \
+        * keep[:, None].astype(probs.dtype)
+    # dispatch_j[s, e, c] = m[j*S+s, e] * slot[j*S+s, c]
+    disp = jnp.einsum("xe,xc->xec", m, slot).reshape(k, S, E, capacity)
+    weights = jnp.transpose(topv, (1, 0))                # [k, S]
+    combine = jnp.einsum("ks,ksec->sec", weights, disp)
+    dispatch = disp.sum(0)                               # [S, E, C] (0/1 by construction)
+    return combine, dispatch, l_aux
+
+
+class BaseGate(nn.Layer):
+    """reference: gate/base_gate.py — holds num_expert/world_size and the aux loss."""
+
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    @property
+    def l_aux(self):
+        return self.loss
+
+    def capacity(self, num_tokens):
+        raise NotImplementedError
+
+    def _routing(self, xv, w, b):
+        """Pure fn of raw arrays -> (combine, dispatch, l_aux)."""
+        raise NotImplementedError
+
+    def forward(self, x):
+        out = run_op(self.__class__.__name__.lower(), self._routing,
+                     [x, self.gate.weight, self.gate.bias])
+        self.set_loss(out[2])
+        return out  # (combine [S,E,C], dispatch [S,E,C], l_aux)
+
+
+class NaiveGate(BaseGate):
+    """Linear router + plain top-k, no capacity drop (reference: naive_gate.py).
+
+    Dense form: capacity = S so no token is ever dropped."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.top_k = topk
+        self.gate = nn.Linear(d_model, self.tot_expert)
+
+    def capacity(self, num_tokens):
+        return int(num_tokens)
+
+    def _routing(self, xv, w, b):
+        probs = jax.nn.softmax((xv @ w + b).astype(jnp.float32), axis=-1)
+        c, d, l = _topk_dispatch(probs, self.top_k, xv.shape[0], normalize_topk=True)
+        return c.astype(xv.dtype), d.astype(xv.dtype), l
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with capacity + load-balance loss (reference: gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(num_expert, world_size)
+        assert topk == 2, "gshard gate is top-2"
+        self.top_k = 2
+        self.capacity_factor = capacity  # (train, eval) multipliers
+        self.random_routing = random_routing
+        self.gate = nn.Linear(d_model, self.tot_expert)
+
+    def capacity(self, num_tokens):
+        f = self.capacity_factor[0] if self.training else self.capacity_factor[1]
+        return max(1, int(math.ceil(f * num_tokens / self.tot_expert)))
+
+    def _routing(self, xv, w, b):
+        probs = jax.nn.softmax((xv @ w + b).astype(jnp.float32), axis=-1)
+        cap = self.capacity(xv.shape[0])
+        c, d, l = _topk_dispatch(probs, 2, cap, normalize_topk=True)
+        return c.astype(xv.dtype), d.astype(xv.dtype), l
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch routing with jitter noise (reference: switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(num_expert, world_size)
+        assert topk == 1, "switch gate is top-1"
+        self.top_k = 1
+        self.switch_eps = switch_eps
+        self.capacity_factor = capacity
+        self.gate = nn.Linear(d_model, self.tot_expert)
+
+    def capacity(self, num_tokens):
+        f = self.capacity_factor[0] if self.training else self.capacity_factor[1]
+        return max(1, int(math.ceil(f * num_tokens / self.tot_expert)))
+
+    def _routing(self, xv, w, b):
+        logits = xv @ w + b
+        if self.training and self.switch_eps > 0:
+            noise = jax.random.uniform(rnd.next_key(), logits.shape, logits.dtype,
+                                       1.0 - self.switch_eps, 1.0 + self.switch_eps)
+            logits = logits * noise
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        cap = self.capacity(xv.shape[0])
+        c, d, l = _topk_dispatch(probs, 1, cap, normalize_topk=False)
+        return c.astype(xv.dtype), d.astype(xv.dtype), l

@@ -1,0 +1,151 @@
+"""Mixture-of-experts layer with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:261
+(MoELayer) — there, routing produces index lists, tokens are exchanged with
+custom `global_scatter`/`global_gather` NCCL all-to-alls, and each rank runs
+its local experts.
+
+TPU-native redesign (GShard formulation — MoE was born on TPU): routing
+produces dense dispatch/combine tensors and the whole layer is three einsums
+
+    xe  = einsum('tec,tm->ecm', dispatch, x)      # dispatch
+    ye  = expert_ffn(xe)                          # [E,C,M] -> [E,C,M] batched GEMMs
+    out = einsum('tec,ecm->tm', combine, ye)      # combine
+
+When the expert axis E is sharded over a mesh axis (expert parallelism), the
+sharding constraint on `xe`/`ye` makes GSPMD insert the all-to-alls on ICI —
+the compiled equivalent of the reference's global_scatter/global_gather.
+Static shapes (capacity) keep everything jit-compatible; overflow tokens are
+dropped exactly as the reference's capacity pruning does.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.nn as nn
+from .....framework.core import Tensor, run_op
+from ..... import distributed as _dist_pkg  # noqa: F401  (package init ordering)
+from .....distributed import env as _env
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer", "ExpertFFN"]
+
+
+def _constrain_value(v, spec):
+    """with_sharding_constraint on a raw array when a global mesh exists."""
+    mesh = _env.get_global_mesh()
+    if mesh is None:
+        return v
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx is not None and not ctx.empty and ctx.manual_axes:
+            manual = set(ctx.manual_axes)
+            spec = P(*[None if s in manual else s for s in spec])
+            return jax.lax.with_sharding_constraint(v, jax.sharding.NamedSharding(ctx, spec))
+        return jax.lax.with_sharding_constraint(v, jax.sharding.NamedSharding(mesh, spec))
+    except Exception:
+        return v
+
+
+class ExpertFFN(nn.Layer):
+    """Stacked expert FFN: all experts' weights in one [E, ...] tensor so the
+    expert dimension is a real mesh-shardable axis and the per-expert GEMMs
+    batch onto the MXU (replaces the reference's per-expert Linear list +
+    fused_moe cutlass grouped GEMM, fusion/cutlass/fused_moe_kernel.cu)."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu",
+                 ep_axis=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden])
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model])
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+        self.activation = activation
+        if ep_axis:
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                p.dist_attr = P(ep_axis, *([None] * (len(p.shape) - 1)))
+                p.is_distributed = True
+
+    def forward(self, xe):
+        """xe: [E, C, M] -> [E, C, M]."""
+        act = getattr(jax.nn, self.activation)
+
+        def fn(x, w1, b1, w2, b2):
+            h = jnp.einsum("ecm,emh->ech", x, w1) + b1
+            h = act(h)
+            return jnp.einsum("ech,ehm->ecm", h, w2) + b2
+
+        return run_op("expert_ffn", fn, [xe, self.w1, self.b1, self.w2, self.b2])
+
+
+class MoELayer(nn.Layer):
+    """reference: moe_layer.py:261 — MoELayer(d_model, experts, gate, moe_group).
+
+    `experts` is either an ExpertFFN (stacked fast path, expert-parallel
+    capable) or a list of nn.Layer (reference-parity path; each expert applied
+    to its [C, M] slice — replicated, eager/jit both fine).
+    `gate` is a BaseGate instance or a config dict {"type": "gshard"|"switch"|
+    "naive", "top_k": k} exactly like the reference's gate config.
+    `ep_axis` names the mesh axis experts shard over (the analog of
+    moe_group — the reference uses the data-parallel group)."""
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None, mp_group=None,
+                 recompute_interval=0, ep_axis=None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.ep_axis = ep_axis
+        if isinstance(experts, ExpertFFN):
+            self.experts = experts
+            self.num_expert = experts.num_experts
+            self._stacked = True
+        else:
+            self.experts = nn.LayerList(experts)
+            self.num_expert = len(experts)
+            self._stacked = False
+
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        else:
+            cfg = dict(gate or {})
+            gtype = cfg.pop("type", "gshard")
+            topk = cfg.pop("top_k", 2)
+            cls = {"gshard": GShardGate, "switch": SwitchGate, "naive": NaiveGate}[gtype]
+            self.gate = cls(d_model, self.num_expert, topk=topk, **cfg)
+
+    @property
+    def l_aux(self):
+        return self.gate.l_aux
+
+    def forward(self, inp):
+        shape = inp.shape
+        x = inp.reshape([-1, self.d_model])
+        combine, dispatch, _l_aux = self.gate(x)
+
+        spec_e = P(self.ep_axis, None, None) if self.ep_axis else None
+
+        def dispatch_fn(d, xv):
+            xe = jnp.einsum("tec,tm->ecm", d, xv)
+            if spec_e is not None:
+                xe = _constrain_value(xe, spec_e)
+            return xe
+
+        xe = run_op("moe_dispatch", dispatch_fn, [dispatch, x])
+
+        if self._stacked:
+            ye = self.experts(xe)
+        else:
+            outs = [self.experts[e](xe[e]) for e in range(self.num_expert)]
+            ye = run_op("moe_stack", lambda *ys: jnp.stack(ys, 0), outs)
+
+        def combine_fn(c, yv):
+            if spec_e is not None:
+                yv = _constrain_value(yv, spec_e)
+            return jnp.einsum("tec,ecm->tm", c, yv)
+
+        out = run_op("moe_combine", combine_fn, [combine, ye])
+        return out.reshape(shape[:-1] + [self.d_model] if isinstance(shape, list)
+                           else list(shape[:-1]) + [self.d_model])
