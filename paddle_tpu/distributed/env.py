@@ -157,3 +157,37 @@ def default_mesh():
     if m is None:
         m = build_mesh(dp=len(_jax().devices()))
     return m
+
+
+def constrain_array(a, spec):
+    """with_sharding_constraint on a raw array against the global mesh,
+    stripping axes that are Manual in the current shard_map context (a
+    concrete all-Auto mesh sharding poisons downstream op types there).
+    Shared by the mpu layers and MoE; returns `a` unchanged when no mesh."""
+    import warnings
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = get_global_mesh()
+    if mesh is None:
+        return a
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx is not None and not ctx.empty and ctx.manual_axes:
+            manual = set(ctx.manual_axes)
+
+            def strip(entry):
+                if entry is None:
+                    return None
+                if isinstance(entry, tuple):
+                    kept = tuple(e for e in entry if e not in manual)
+                    return kept if kept else None
+                return None if entry in manual else entry
+
+            spec = P(*[strip(s) for s in spec])
+            return jax.lax.with_sharding_constraint(a, NamedSharding(ctx, spec))
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+    except Exception as e:  # pragma: no cover - diagnostic path
+        warnings.warn(f"sharding constraint {spec} skipped: {e}")
+        return a

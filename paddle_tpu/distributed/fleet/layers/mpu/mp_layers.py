@@ -56,30 +56,7 @@ def _constrain(x: Tensor, spec: P) -> Tensor:
         return x
 
     def fn(a):
-        import jax
-
-        try:
-            ctx = jax.sharding.get_abstract_mesh()
-            if ctx is not None and not ctx.empty and ctx.manual_axes:
-                manual = set(ctx.manual_axes)
-
-                def strip(entry):
-                    if entry is None:
-                        return None
-                    if isinstance(entry, tuple):
-                        kept = tuple(e for e in entry if e not in manual)
-                        return kept if kept else None
-                    return None if entry in manual else entry
-
-                spec2 = P(*[strip(s) for s in spec])
-                return jax.lax.with_sharding_constraint(
-                    a, jax.sharding.NamedSharding(ctx, spec2)
-                )
-            return jax.lax.with_sharding_constraint(
-                a, jax.sharding.NamedSharding(mesh, spec)
-            )
-        except Exception:
-            return a
+        return _env.constrain_array(a, spec)
 
     return run_op("sharding_constraint", fn, [x])
 

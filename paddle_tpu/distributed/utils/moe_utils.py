@@ -17,7 +17,28 @@ r * num_local_expert + e, padded to `capacity`.
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..collective import _grp, alltoall_single
+
+
+def _check_uniform_counts(x, local_count, global_count, group):
+    """The static capacity-padded layout implies uniform counts; ragged
+    counts would silently land tokens in wrong expert rows — refuse loudly."""
+    n = _grp(group).nranks
+    rows = x.shape[0]
+    for name, c in (("local_count", local_count), ("global_count", global_count)):
+        if c is None:
+            continue
+        arr = np.asarray(c.numpy() if hasattr(c, "numpy") else c).ravel()
+        if arr.size == 0:
+            continue
+        if not (arr == arr[0]).all() or int(arr.sum()) != rows:
+            raise NotImplementedError(
+                f"{name} must be uniform with sum == x.shape[0] ({rows}) for "
+                "the TPU capacity-padded layout; ragged counts are handled by "
+                "the dense-dispatch MoE layer, not these compatibility shims"
+            )
 
 
 def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
@@ -26,6 +47,7 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
     capacity-padded layout the exchange is exactly one equal-split all-to-all;
     `local_count`/`global_count` are accepted for signature parity (counts are
     implied by the padded layout)."""
+    _check_uniform_counts(x, local_count, global_count, group)
     out = x.clone() if hasattr(x, "clone") else x
     alltoall_single(out, x, group=group)
     return out
@@ -35,6 +57,7 @@ def global_gather(x, local_count, global_count, group=None, use_calc_stream=True
     """Inverse of global_scatter (reference: moe_utils.py global_gather) —
     returns expert outputs to the ranks that own the tokens. The equal-split
     all-to-all is self-inverse on the (src, dst) chunk grid."""
+    _check_uniform_counts(x, local_count, global_count, group)
     out = x.clone() if hasattr(x, "clone") else x
     alltoall_single(out, x, group=group)
     return out

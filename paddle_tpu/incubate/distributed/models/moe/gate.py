@@ -26,13 +26,14 @@ from .....framework.core import run_op
 __all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
 
 
-def _topk_dispatch(probs, k, capacity, normalize_topk):
+def _topk_dispatch(probs, k, capacity, normalize_topk, choice_keep=None):
     """Dense top-k routing with capacity.
 
     probs: [S, E] router probabilities. Returns (combine [S,E,C],
     dispatch [S,E,C] 0/1, l_aux scalar). Tokens overflowing an expert's
     capacity are dropped (zero rows — same semantics as the reference's
-    capacity pruning in gshard_gate.py).
+    capacity pruning in gshard_gate.py). `choice_keep` [S, k] bool drops
+    individual (token, choice) routes (GShard random routing).
     """
     S, E = probs.shape
     topv, topi = jax.lax.top_k(probs, k)  # [S, k]
@@ -40,6 +41,10 @@ def _topk_dispatch(probs, k, capacity, normalize_topk):
         topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
 
     onehot = jax.nn.one_hot(topi, E, dtype=probs.dtype)  # [S, k, E]
+    if choice_keep is not None:
+        keep_f = choice_keep.astype(probs.dtype)
+        onehot = onehot * keep_f[..., None]
+        topv = topv * keep_f
 
     # load-balancing aux loss (GShard eq.4): E * sum_e mean_prob_e * frac_top1_e
     me = probs.mean(0)                                   # [E]
@@ -136,7 +141,17 @@ class GShardGate(BaseGate):
     def _routing(self, xv, w, b):
         probs = jax.nn.softmax((xv @ w + b).astype(jnp.float32), axis=-1)
         cap = self.capacity(xv.shape[0])
-        c, d, l = _topk_dispatch(probs, 2, cap, normalize_topk=True)
+        choice_keep = None
+        if self.random_routing and self.training:
+            # GShard §3.2: the 2nd expert fires with probability ∝ its
+            # weight — kept when 2*w2 > u ~ U(0,1)
+            topv, _ = jax.lax.top_k(probs, 2)
+            u = jax.random.uniform(rnd.next_key(), (xv.shape[0],), jnp.float32)
+            keep2 = (2.0 * topv[:, 1]) > u
+            choice_keep = jnp.stack(
+                [jnp.ones_like(keep2), keep2], axis=-1)
+        c, d, l = _topk_dispatch(probs, 2, cap, normalize_topk=True,
+                                 choice_keep=choice_keep)
         return c.astype(xv.dtype), d.astype(xv.dtype), l
 
 

@@ -34,20 +34,7 @@ from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
 __all__ = ["MoELayer", "ExpertFFN"]
 
 
-def _constrain_value(v, spec):
-    """with_sharding_constraint on a raw array when a global mesh exists."""
-    mesh = _env.get_global_mesh()
-    if mesh is None:
-        return v
-    try:
-        ctx = jax.sharding.get_abstract_mesh()
-        if ctx is not None and not ctx.empty and ctx.manual_axes:
-            manual = set(ctx.manual_axes)
-            spec = P(*[None if s in manual else s for s in spec])
-            return jax.lax.with_sharding_constraint(v, jax.sharding.NamedSharding(ctx, spec))
-        return jax.lax.with_sharding_constraint(v, jax.sharding.NamedSharding(mesh, spec))
-    except Exception:
-        return v
+_constrain_value = _env.constrain_array
 
 
 class ExpertFFN(nn.Layer):

@@ -80,6 +80,11 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     sequence_parallel: bool = False
     use_recompute: bool = False
+    # context parallelism: shard the sequence over the `sep` mesh axis and use
+    # ring attention (paddle_tpu.parallel.ring). TPU-native upgrade over the
+    # reference's bare SEP plumbing (segment_parallel.py:26); implies
+    # attention_dropout_prob == 0.
+    context_parallel: bool = False
 
     @property
     def kv_heads(self):
@@ -178,6 +183,14 @@ class GPTAttention(nn.Layer):
                 q, k_all, v_all, attn_mask=mask, is_causal=False,
                 dropout_p=cfg.attention_dropout_prob, training=self.training,
             )
+        elif cfg.context_parallel:
+            assert cfg.attention_dropout_prob == 0.0, (
+                "context_parallel ring attention does not support attention "
+                "dropout; set attention_dropout_prob=0")
+            q = _constrain(q, P(None, "sep", "mp", None))
+            k = _constrain(k, P(None, "sep", "mp", None))
+            v = _constrain(v, P(None, "sep", "mp", None))
+            out = F.ring_flash_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True,

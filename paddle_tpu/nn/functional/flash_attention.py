@@ -282,3 +282,30 @@ class sdp_kernel:
         global _USE_PALLAS
         _USE_PALLAS = self._saved
         return False
+
+
+def ring_flash_attention(query, key, value, causal=True, axis="sep", name=None):
+    """Context-parallel exact attention: sequence sharded over the `sep` mesh
+    axis, K/V blocks rotating on the ICI ring with online-softmax accumulation
+    (paddle_tpu.parallel.ring). The reference snapshot has no ring attention
+    (SURVEY §5.7) — this is the TPU-native long-context upgrade over its bare
+    SEP-axis plumbing (fleet/meta_parallel/segment_parallel.py:26).
+
+    Falls back to dense reference attention when no mesh is active or the
+    axis degree is 1, so models are portable across parallel configs.
+    """
+    from ...distributed import env as _env
+    from ...parallel.ring import ring_attention_spmd
+
+    mesh = _env.get_global_mesh()
+    use_ring = mesh is not None and mesh.shape.get(axis, 1) > 1
+
+    def fn(q, k, v):
+        if use_ring:
+            return ring_attention_spmd(q, k, v, mesh, axis=axis, causal=causal)
+        return _ref_attention(q, k, v, causal=causal)
+
+    return run_op("ring_flash_attention", fn, [_t(query), _t(key), _t(value)])
+
+
+__all__.append("ring_flash_attention")

@@ -7,5 +7,6 @@ over the hybrid mesh built by paddle_tpu.distributed.env.build_mesh.
 """
 
 from .pipeline import pipeline_spmd, stack_pytrees, unstack_leading
+from .ring import ring_attention_spmd
 
-__all__ = ["pipeline_spmd", "stack_pytrees", "unstack_leading"]
+__all__ = ["pipeline_spmd", "stack_pytrees", "unstack_leading", "ring_attention_spmd"]
