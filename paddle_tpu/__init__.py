@@ -108,3 +108,4 @@ from .hapi import Model, summary  # noqa: E402
 from . import distributed  # noqa: E402
 from .distributed import DataParallel  # noqa: E402
 from . import incubate  # noqa: E402
+from . import inference  # noqa: E402

@@ -25,12 +25,17 @@ from ..collective import _grp, alltoall_single
 def _check_uniform_counts(x, local_count, global_count, group):
     """The static capacity-padded layout implies uniform counts; ragged
     counts would silently land tokens in wrong expert rows — refuse loudly."""
+    import jax
+
     n = _grp(group).nranks
     rows = x.shape[0]
     for name, c in (("local_count", local_count), ("global_count", global_count)):
         if c is None:
             continue
-        arr = np.asarray(c.numpy() if hasattr(c, "numpy") else c).ravel()
+        raw = c._value if hasattr(c, "_value") else c
+        if isinstance(raw, jax.core.Tracer):
+            continue  # traced counts: stay trace-safe, skip the eager check
+        arr = np.asarray(raw).ravel()
         if arr.size == 0:
             continue
         if not (arr == arr[0]).all() or int(arr.sum()) != rows:

@@ -443,3 +443,220 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
 
 
 __all__.append("fused_moe")
+
+
+def masked_multihead_attention(
+    x, cache_kv=None, bias=None, src_mask=None, cum_offsets=None,
+    sequence_lengths=None, rotary_tensor=None, beam_cache_offset=None,
+    qkv_out_scale=None, out_shift=None, out_smooth=None, seq_len=1,
+    rotary_emb_dims=0, use_neox_rotary_style=False, compute_dtype="default",
+    out_scale=-1, quant_round_type=1, quant_max_bound=127.0,
+    quant_min_bound=-127.0, name=None):
+    """Single-step decode attention with KV cache (reference:
+    python/paddle/incubate/nn/functional/masked_multihead_attention.py, kernel
+    fusion/gpu/masked_multihead_attention_kernel.cu / mmha_util.cu.h).
+
+    TPU-native: one traced function — per-row dynamic cache write
+    (dynamic_update_slice) + masked attention over the static-capacity cache;
+    XLA fuses the epilogue. Decode is HBM-bound, so keeping the cache resident
+    and reading it once is the whole game.
+
+    x: [B, 3*H*D] fused qkv for ONE step. cache_kv: [2, B, H, S_max, D]
+    (reference layout). sequence_lengths: [B] current lengths (cache write
+    offset). Returns (out [B, H*D], updated cache_kv). Quant/beam/rotary
+    tensor paths are not supported.
+    """
+    from ....nn.functional._attn_math import masked_attention
+
+    if any(a is not None for a in (rotary_tensor, beam_cache_offset,
+                                   qkv_out_scale, out_shift, out_smooth)) \
+            or out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: quant/beam/rotary-tensor paths are "
+            "not supported on TPU")
+    assert cache_kv is not None, "cache_kv is required"
+
+    ins = [_t(x), _t(cache_kv)]
+    has_bias = bias is not None
+    has_mask = src_mask is not None
+    has_lens = sequence_lengths is not None
+    if has_bias:
+        ins.append(_t(bias))
+    if has_mask:
+        ins.append(_t(src_mask))
+    if has_lens:
+        ins.append(_t(sequence_lengths))
+
+    def fn(xv, cache, *rest):
+        it = iter(rest)
+        b = next(it) if has_bias else None
+        mask = next(it) if has_mask else None
+        lens = next(it) if has_lens else None
+        B = xv.shape[0]
+        _, _, H, S_max, D = cache.shape
+        qkv = xv.reshape(B, 3, H, D)
+        if b is not None:
+            qkv = qkv + b.reshape(1, 3, H, D)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        if lens is None:
+            lens = jnp.zeros((B,), jnp.int32)
+        lens = lens.reshape(B).astype(jnp.int32)
+
+        # per-row cache write at offset lens[b]
+        def write(cache_row, kv, off):
+            # cache_row [H, S_max, D]; kv [H, D]
+            return jax.lax.dynamic_update_slice(
+                cache_row, kv[:, None, :].astype(cache_row.dtype), (0, off, 0))
+
+        k_cache = jax.vmap(write)(cache[0], k_new, lens)
+        v_cache = jax.vmap(write)(cache[1], v_new, lens)
+
+        keep = (jnp.arange(S_max)[None, :] <= lens[:, None])[:, None, None, :]
+        add = mask.reshape(B, 1, 1, -1)[..., :S_max] if mask is not None else None
+        out = masked_attention(
+            q[:, None],  # [B, 1, H, D]
+            jnp.moveaxis(k_cache, 1, 2), jnp.moveaxis(v_cache, 1, 2),
+            keep=keep, add_mask=add)
+        new_cache = jnp.stack([k_cache, v_cache], 0)
+        return out.reshape(B, H * D).astype(xv.dtype), new_cache
+
+    return run_op("masked_multihead_attention", fn, ins)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None, name=None):
+    """Max enc/dec lengths for block attention (reference:
+    paddle/phi/kernels/fusion/gpu/blha_get_max_len.cu)."""
+    def fn(e, d):
+        return jnp.max(e).reshape(1), jnp.max(d).reshape(1)
+
+    return run_op("blha_get_max_len", fn, [_t(seq_lens_encoder), _t(seq_lens_decoder)])
+
+
+def block_multihead_attention(
+    qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+    seq_lens_this_time, padding_offsets=None, cum_offsets=None,
+    cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None,
+    pre_key_cache=None, pre_value_cache=None, cache_k_quant_scales=None,
+    cache_v_quant_scales=None, cache_k_dequant_scales=None,
+    cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None,
+    out_shift=None, out_smooth=None, max_enc_len_this_time=None,
+    max_dec_len_this_time=None, rope_emb=None, mask=None, tgt_mask=None,
+    max_seq_len=-1, block_size=64, use_neox_style=False, name=None, **quant_kw):
+    """Paged-KV-cache attention (reference: block_multihead_attention,
+    python/paddle/incubate/nn/functional/block_multihead_attention.py, kernel
+    fusion/gpu/block_multi_head_attention_kernel.cu + block_attn.h).
+
+    TPU-native redesign with DENSE PADDED batches (static shapes for XLA)
+    instead of the reference's ragged packed-token layout:
+    - qkv: [B, S, 3*H*D] (prefill: S = prompt len; decode: S = 1)
+    - key_cache/value_cache: [max_blocks, kv_heads, block_size, head_dim]
+      (the reference's paged layout) — functionally updated and returned
+    - block_tables: [B, max_blocks_per_seq] page ids (-1 = unused)
+    - seq_lens_encoder: [B] prompt lens (prefill rows; 0 = decode row)
+    - seq_lens_decoder: [B] tokens already in cache (decode offset)
+    Mode is per-row: rows with seq_lens_encoder > 0 run prefill (causal over
+    their prompt); rows with seq_lens_this_time == 1 run paged decode.
+    Returns (out [B, S, H*D], qkv, key_cache, value_cache) like the reference.
+    Quant/pre-cache paths are unsupported.
+    """
+    from ....nn.functional._attn_math import masked_attention
+
+    if any(v is not None for v in (pre_key_cache, pre_value_cache,
+                                   cache_k_quant_scales, qkv_out_scale,
+                                   out_shift, out_smooth)):
+        raise NotImplementedError("block_multihead_attention quant/pre-cache "
+                                  "paths are not supported on TPU")
+    assert block_tables is not None, "block_tables is required"
+
+    ins = [_t(qkv), _t(key_cache), _t(value_cache), _t(seq_lens_encoder),
+           _t(seq_lens_decoder), _t(block_tables)]
+    has_bias = qkv_bias is not None
+    if has_bias:
+        ins.append(_t(qkv_bias))
+
+    def fn(qkv_v, kc, vc, enc_lens, dec_lens, tables, *rest):
+        b = rest[0] if has_bias else None
+        B, S = qkv_v.shape[0], qkv_v.shape[1]
+        n_blocks, Hkv, bs, D = kc.shape
+        HD3 = qkv_v.shape[-1]
+        H = (HD3 // D - 2 * Hkv)
+        q3 = qkv_v.reshape(B, S, -1, D)
+        if b is not None:
+            q3 = q3 + b.reshape(1, 1, -1, D)
+        q = q3[:, :, :H]                       # [B, S, H, D]
+        k_new = q3[:, :, H:H + Hkv]            # [B, S, Hkv, D]
+        v_new = q3[:, :, H + Hkv:]
+        enc_lens = enc_lens.reshape(B).astype(jnp.int32)
+        dec_lens = dec_lens.reshape(B).astype(jnp.int32)
+        offs = jnp.where(enc_lens > 0, 0, dec_lens)  # write offset per row
+
+        # ---- scatter new K/V into pages (invalid writes -> OOB page, drop) --
+        pos = offs[:, None] + jnp.arange(S)[None, :]          # [B, S] absolute
+        page_idx = pos // bs
+        slot = pos % bs
+        page_ids = jnp.take_along_axis(
+            jnp.where(tables >= 0, tables, n_blocks),
+            jnp.minimum(page_idx, tables.shape[1] - 1), axis=1)  # [B, S]
+        write_valid = pos < (offs + jnp.where(enc_lens > 0, enc_lens, 1))[:, None]
+        flat_pages = jnp.where(write_valid, page_ids, n_blocks).reshape(-1)
+        flat_slot = slot.reshape(-1)
+        kn = k_new.reshape(B * S, Hkv, D)
+        vn = v_new.reshape(B * S, Hkv, D)
+        kc = kc.at[flat_pages, :, flat_slot].set(kn.astype(kc.dtype), mode="drop")
+        vc = vc.at[flat_pages, :, flat_slot].set(vn.astype(vc.dtype), mode="drop")
+
+        # ---- gather pages & attend ----
+        max_pages = tables.shape[1]
+        S_max = max_pages * bs
+        gk = kc[jnp.where(tables >= 0, tables, 0)]             # [B, P, Hkv, bs, D]
+        gv = vc[jnp.where(tables >= 0, tables, 0)]
+        gk = jnp.moveaxis(gk, 2, 3).reshape(B, S_max, Hkv, D)
+        gv = jnp.moveaxis(gv, 2, 3).reshape(B, S_max, Hkv, D)
+        # causal w.r.t. absolute positions; also clip to valid cache range
+        qpos = pos                                              # [B, S]
+        kpos = jnp.arange(S_max)[None, :]
+        keep = kpos[:, None, :] <= qpos[..., None]              # [B, S, S_max]
+        total = offs + jnp.where(enc_lens > 0, enc_lens, 1)
+        keep = keep & (kpos[:, None, :] < total[:, None, None])
+        out = masked_attention(q, gk, gv, keep=keep[:, None])
+        return (out.reshape(B, S, H * D).astype(qkv_v.dtype), qkv_v, kc, vc)
+
+    return run_op("block_multihead_attention", fn, ins)
+
+
+def variable_length_memory_efficient_attention(
+    query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+    causal=False, pre_cache_length=0, name=None):
+    """Varlen attention on padded batches (reference:
+    python/paddle/incubate/nn/functional/variable_length_memory_efficient_attention.py,
+    cutlass memory_efficient_attention kernel). q/k/v: [B, H, S, D];
+    seq_lens/kv_seq_lens: [B] valid lengths. Causal masking is bottom-right
+    aligned per row (last query row ↔ last valid key — flash-attn convention)."""
+    from ....nn.functional._attn_math import bottom_right_causal_keep, masked_attention
+
+    ins = [_t(query), _t(key), _t(value), _t(seq_lens), _t(kv_seq_lens)]
+    has_mask = mask is not None
+    if has_mask:
+        ins.append(_t(mask))
+
+    def fn(q, k, v, ql, kl, *rest):
+        m = rest[0] if has_mask else None
+        B, H, Sq, D = q.shape
+        Sk = k.shape[2]
+        ql = ql.reshape(B).astype(jnp.int32)
+        kl = kl.reshape(B).astype(jnp.int32)
+        if causal:
+            keep = bottom_right_causal_keep(Sq, Sk, q_lens=ql, kv_lens=kl)
+        else:
+            keep = (jnp.arange(Sk)[None, :] < kl[:, None])[:, None, None, :]
+        out = masked_attention(jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
+                               jnp.moveaxis(v, 1, 2), keep=keep, add_mask=m,
+                               scale=scale)
+        return jnp.moveaxis(out, 1, 2)
+
+    return run_op("variable_length_memory_efficient_attention", fn, ins)
+
+
+__all__ += ["masked_multihead_attention", "blha_get_max_len",
+            "block_multihead_attention",
+            "variable_length_memory_efficient_attention"]

@@ -1,0 +1,3 @@
+from .fused_transformer import FusedMultiTransformer  # noqa: F401
+
+__all__ = ["FusedMultiTransformer"]

@@ -29,7 +29,7 @@ from ..framework import random as rnd
 from ..framework.core import Parameter, Tensor, no_grad, to_tensor, tracing_guard
 from ..nn.layer.layers import Layer
 
-__all__ = ["to_static", "TrainStep", "functional_call", "save", "load", "not_to_static", "ignore_module"]
+__all__ = ["to_static", "TrainStep", "functional_call", "save", "load", "not_to_static", "ignore_module", "InputSpec", "TranslatedLayer"]
 
 
 def _unwrap_pytree(obj):
@@ -364,20 +364,100 @@ def _amp_ctx(level, dtype):
     return contextlib.nullcontext()
 
 
+class InputSpec:
+    """Shape/dtype spec for traced export (reference: paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def _sds(self, scope=None):
+        from ..framework import dtype as dtype_mod
+
+        dt = jnp.dtype(dtype_mod.convert_dtype(self.dtype))
+        if any(d is None for d in self.shape):
+            # dynamic dims (the reference's None batch dims) -> jax.export
+            # symbolic shapes; one shared scope per save() call
+            from jax import export as jexport
+
+            names = iter("abcdefghijklmnop")
+            dims = ",".join(str(d) if d is not None else next(names)
+                            for d in self.shape)
+            shape = jexport.symbolic_shape(dims, scope=scope)
+            return jax.ShapeDtypeStruct(shape, dt)
+        return jax.ShapeDtypeStruct(self.shape, dt)
+
+
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persist weights + a descriptor (reference saves a
-    translated Program; we save state_dict + forward signature metadata and
-    reconstruct via the source class on load)."""
+    """paddle.jit.save (reference: python/paddle/jit/api.py) — persist weights
+    AND, when input_spec is given, the traced program itself: the forward is
+    traced to StableHLO via jax.export (params captured as constants) and
+    serialized to `path`.pdmodel — the analog of the reference's saved
+    Program/PIR artifact. Weights always go to `path`.pdparams."""
     from ..framework.io import save as fsave
 
-    if isinstance(layer, Layer):
-        state = layer.state_dict()
-        fsave({"state_dict": state, "class": type(layer).__qualname__}, path + ".pdparams")
-    else:
+    if not isinstance(layer, Layer):
         raise TypeError("jit.save expects a Layer")
+    state = layer.state_dict()
+    fsave({"state_dict": state, "class": type(layer).__qualname__}, path + ".pdparams")
+    if input_spec is not None:
+        from jax import export as jexport
+
+        params = {k: p._value for k, p in layer.named_parameters()}
+        buffers = {k: b._value for k, b in layer.named_buffers()}
+
+        def fwd(*xs):
+            out, _ = functional_call(layer, params, buffers,
+                                     [Tensor(x) for x in xs], train=False)
+            return out
+
+        from jax import export as _jexp
+
+        scope = _jexp.SymbolicScope()
+        sds = [s._sds(scope) if isinstance(s, InputSpec) else
+               jax.ShapeDtypeStruct(tuple(s.shape), jnp.dtype(s.dtype))
+               for s in input_spec]
+        exported = jexport.export(jax.jit(fwd))(*sds)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+
+
+class TranslatedLayer(Layer):
+    """A loaded saved-program (reference: TranslatedLayer from paddle.jit.load
+    running a deserialized Program on the executor) — here a deserialized
+    StableHLO program invoked through jax.export."""
+
+    def __init__(self, exported, state=None):
+        super().__init__()
+        self._exported = exported
+        self._state = state or {}
+
+    def forward(self, *args):
+        raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._exported.call(*raw)
+        return _wrap_pytree(out)
+
+    def state_dict(self, *a, **kw):
+        return dict(self._state)
+
+    @property
+    def input_shapes(self):
+        return [tuple(a.shape) for a in self._exported.in_avals]
 
 
 def load(path, **configs):
+    """paddle.jit.load — with a .pdmodel program file returns a runnable
+    TranslatedLayer; otherwise returns the saved dict (weights-only load)."""
+    import os
+
+    from jax import export as jexport
+
     from ..framework.io import load as fload
 
-    return fload(path + ".pdparams")
+    payload = fload(path + ".pdparams")
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(f.read())
+        return TranslatedLayer(exported, payload.get("state_dict"))
+    return payload

@@ -1,0 +1,131 @@
+"""Autoregressive generation for the causal-LM models.
+
+Reference analog: the decode loops PaddleNLP builds over
+fused_multi_transformer / masked_multihead_attention (the framework itself
+ships the kernels; SURVEY §2.2 block attention / MMHA). TPU-native design:
+prefill and per-token decode are TWO jitted programs with static shapes
+(prompt padded to a bucket, cache at fixed capacity); the python loop only
+feeds back the sampled token — every FLOP is inside XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import random as rnd
+
+__all__ = ["generate"]
+
+
+def _sample(logits, temperature, top_k, top_p, key):
+    """logits [B, V] -> token ids [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    V = logits.shape[-1]
+    if top_k and top_k > 0 and top_k < V:
+        kth = jnp.sort(logits, -1)[:, V - top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, -1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, -1)
+        cum = jnp.cumsum(probs, -1)
+        # keep the smallest set with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, -1)  # [B]
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], -1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, -1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+             top_p=1.0, eos_token_id=None, use_cache=True, seed=None):
+    """Greedy/sampled decoding. input_ids: Tensor or ndarray [B, S_prompt].
+    Returns Tensor [B, S_prompt + n_generated] (stops early when every row
+    emitted eos_token_id)."""
+    from ..jit import functional_call
+
+    ids = input_ids.numpy() if isinstance(input_ids, Tensor) else np.asarray(input_ids)
+    ids = ids.astype(np.int32)
+    B, S0 = ids.shape
+    total = S0 + max_new_tokens
+    was_training = model.training
+    model.eval()
+    params = {k: p._value for k, p in model.named_parameters()}
+    buffers = {k: b._value for k, b in model.named_buffers()}
+    cfg = model.config
+    caches = [(jnp.zeros((B, total, cfg.kv_heads, cfg.head_dim), jnp.float32),) * 2
+              for _ in range(cfg.num_layers)]
+
+    greedy = temperature == 0.0
+
+    def prefill(p, b, tok, cache_list, key):
+        pos = jnp.arange(S0)[None, :].repeat(B, 0)
+        c = [(Tensor(k_), Tensor(v_)) for k_, v_ in cache_list]
+        (logits, new_c), _ = functional_call(
+            model, p, b, [Tensor(tok), Tensor(pos), c, Tensor(jnp.int32(0))],
+            train=False)
+        nxt = _sample(logits[:, -1], temperature, top_k, top_p, key)
+        return nxt, new_c
+
+    def decode(p, b, tok, cache_list, off, key):
+        pos = off[None, None] + jnp.zeros((B, 1), jnp.int32)
+        c = [(Tensor(k_), Tensor(v_)) for k_, v_ in cache_list]
+        (logits, new_c), _ = functional_call(
+            model, p, b, [Tensor(tok[:, None]), Tensor(pos), c, Tensor(off)],
+            train=False)
+        nxt = _sample(logits[:, -1], temperature, top_k, top_p, key)
+        return nxt, new_c
+
+    # cache the compiled programs on the model so repeated generate() calls
+    # with the same shapes/sampling config reuse them (jit's cache is keyed
+    # by closure identity, which would otherwise miss every call)
+    jit_cache = model.__dict__.setdefault("_generation_jit_cache", {})
+    cache_key = (B, S0, total, temperature, top_k, top_p)
+    if cache_key in jit_cache:
+        prefill_j, decode_j = jit_cache[cache_key]
+    else:
+        prefill_j = jax.jit(prefill)
+        decode_j = jax.jit(decode, donate_argnums=(3,))
+        jit_cache[cache_key] = (prefill_j, decode_j)
+
+    key = jax.random.PRNGKey(seed if seed is not None else 0) if not greedy \
+        else jax.random.PRNGKey(0)
+
+    if use_cache:
+        key, sub = jax.random.split(key)
+        nxt, caches = prefill_j(params, buffers, ids, caches, sub)
+        out_ids = [ids, np.asarray(nxt)[:, None]]
+        finished = np.zeros(B, bool)
+        if eos_token_id is not None:
+            finished |= np.asarray(nxt) == eos_token_id
+        for step in range(1, max_new_tokens):
+            if eos_token_id is not None and finished.all():
+                break
+            key, sub = jax.random.split(key)
+            nxt, caches = decode_j(params, buffers, nxt,
+                                   caches, jnp.int32(S0 + step - 1), sub)
+            tok = np.asarray(nxt)
+            if eos_token_id is not None:
+                tok = np.where(finished, eos_token_id, tok)
+                finished |= tok == eos_token_id
+            out_ids.append(tok[:, None].astype(np.int32))
+        result = np.concatenate(out_ids, axis=1)
+    else:
+        # no-cache fallback: re-run the full (growing) sequence each step
+        seq = ids
+        for step in range(max_new_tokens):
+            logits, _ = functional_call(
+                model, params, buffers, [Tensor(seq)], train=False)
+            key, sub = jax.random.split(key)
+            nxt = np.asarray(_sample(logits[:, -1], temperature,
+                                     top_k, top_p, sub))
+            seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], 1)
+            if eos_token_id is not None and (nxt == eos_token_id).all():
+                break
+        result = seq
+    if was_training:
+        model.train()
+    return Tensor(jnp.asarray(result))

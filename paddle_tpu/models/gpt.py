@@ -435,3 +435,13 @@ def gpt3_6p7b(**kw):
 
 def gpt3_13b(**kw):
     return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40, **kw)
+
+
+def _generate_method(self, input_ids, **kwargs):
+    """Autoregressive decoding (paddle_tpu.models.generation.generate)."""
+    from .generation import generate as _generate
+
+    return _generate(self, input_ids, **kwargs)
+
+
+GPTForCausalLM.generate = _generate_method

@@ -1,0 +1,59 @@
+"""Shared dense-attention math used by every non-Pallas attention entry point
+(scaled_dot_product_attention fallback, MMHA, paged block attention, varlen
+attention, FusedMultiTransformer). One implementation of the f32-softmax
+masked attention so mask constants / dtype policy can't drift between them."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(k, v, num_q_heads, head_axis=2):
+    """GQA/MQA: repeat kv heads up to num_q_heads along head_axis."""
+    hkv = k.shape[head_axis]
+    if hkv != num_q_heads:
+        rep = num_q_heads // hkv
+        k = jnp.repeat(k, rep, axis=head_axis)
+        v = jnp.repeat(v, rep, axis=head_axis)
+    return k, v
+
+
+def masked_attention(q, k, v, keep=None, add_mask=None, scale=None):
+    """q [B, Sq, H, D], k/v [B, Sk, H(kv), D] -> [B, Sq, H, D].
+
+    keep: broadcastable bool to [B, H, Sq, Sk] (True = attend).
+    add_mask: additive f32 mask broadcastable to [B, H, Sq, Sk].
+    Softmax in f32, output cast back to q.dtype.
+    """
+    D = q.shape[-1]
+    k, v = repeat_kv(k, v, q.shape[2])
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    if keep is not None:
+        logits = jnp.where(keep, logits, NEG_INF)
+    if add_mask is not None:
+        logits = logits + add_mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def bottom_right_causal_keep(sq, sk, q_lens=None, kv_lens=None):
+    """Bottom-right-aligned causal keep mask (the flash-attn convention this
+    repo uses everywhere: the LAST query row aligns with the last valid key).
+
+    Returns bool [B, 1, Sq, Sk] when lens given, else [1, 1, Sq, Sk].
+    """
+    qpos = jnp.arange(sq)[:, None]
+    kpos = jnp.arange(sk)[None, :]
+    if q_lens is None and kv_lens is None:
+        return (kpos <= qpos + (sk - sq))[None, None]
+    q_lens = q_lens.reshape(-1, 1, 1).astype(jnp.int32)
+    kv_lens = kv_lens.reshape(-1, 1, 1).astype(jnp.int32)
+    causal = kpos[None] <= qpos[None] + (kv_lens - q_lens)
+    valid = kpos[None] < kv_lens
+    return (causal & valid)[:, None]

@@ -40,9 +40,11 @@ def _local_ring_attention(q, k, v, *, axis, n, causal, scale):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     @jax.checkpoint
-    def step(carry, _):
-        ks, vs, m, l, acc, s = carry
-        src = (idx - s) % n  # global chunk id the current K/V block came from
+    def block_update(carry_mla, ks, vs, s):
+        """Online-softmax update with the K/V block that came from chunk
+        (idx - s) mod n."""
+        m, l, acc = carry_mla
+        src = (idx - s) % n
         logits = jnp.einsum("bihgd,bjhd->bhgij", qf, ks.astype(jnp.float32)) * scale
         if causal:
             grow = idx * L + rows[:, None]   # global query row
@@ -54,15 +56,22 @@ def _local_ring_attention(q, k, v, *, axis, n, causal, scale):
         l_new = l * alpha + p.sum(-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bhgij,bjhd->bhgid", p, vs.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    def step(carry, s):
+        ks, vs, mla = carry
+        # permute FIRST: n-1 hops total, the last block is consumed in place
         ks = jax.lax.ppermute(ks, axis, perm)
         vs = jax.lax.ppermute(vs, axis, perm)
-        return (ks, vs, m_new, l_new, acc_new, s + 1), None
+        return (ks, vs, block_update(mla, ks, vs, s)), None
 
     m0 = jnp.full((B, Hkv, G, L), _NEG, jnp.float32)
     l0 = jnp.zeros((B, Hkv, G, L), jnp.float32)
     a0 = jnp.zeros((B, Hkv, G, L, D), jnp.float32)
-    init = (k, v, m0, l0, a0, jnp.int32(0))
-    (_, _, m, l, acc, _), _ = jax.lax.scan(step, init, None, length=n)
+    mla = block_update((m0, l0, a0), k, v, jnp.int32(0))  # local block, no hop
+    if n > 1:
+        (_, _, mla), _ = jax.lax.scan(step, (k, v, mla), jnp.arange(1, n))
+    m, l, acc = mla
     out = acc / jnp.maximum(l, 1e-30)[..., None]        # [B, Hkv, G, L, D]
     out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, L, H, D)
     return out.astype(q.dtype)

@@ -72,7 +72,14 @@ def _t(x):
 def _static_shape(shape):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    return tuple(int(s) for s in shape)
+
+    def coerce(s):
+        try:
+            return int(s)
+        except Exception:
+            return s  # symbolic dim (jax.export shape polymorphism)
+
+    return tuple(coerce(s) for s in shape)
 
 
 def reshape(x, shape, name=None):

@@ -1,0 +1,210 @@
+"""Inference/decode path tests: generation, MMHA, paged block attention,
+FusedMultiTransformer, jit.save program export, inference.Predictor.
+
+Oracle: dense attention / full-sequence forward (the reference's OpTest
+pattern — kernel result vs straightforward computation)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+from paddle_tpu.incubate.nn.layer import FusedMultiTransformer
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+
+def _softmax(x):
+    return np.asarray(jax.nn.softmax(jnp.asarray(x), -1))
+
+
+class TestGenerate:
+    def _model(self):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=64)
+        return GPTForCausalLM(cfg)
+
+    def test_greedy_cache_matches_nocache(self):
+        m = self._model()
+        ids = np.random.RandomState(0).randint(0, 128, (2, 8)).astype(np.int32)
+        a = m.generate(ids, max_new_tokens=6, temperature=0.0).numpy()
+        b = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                       use_cache=False).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (2, 14)
+
+    def test_llama_style_gqa_rope(self):
+        paddle.seed(1)
+        cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+                        num_kv_heads=2, norm_type="rmsnorm", activation="swiglu",
+                        use_rope=True, max_position_embeddings=64,
+                        tie_word_embeddings=False)
+        m = GPTForCausalLM(cfg)
+        ids = np.random.RandomState(1).randint(0, 96, (2, 6)).astype(np.int32)
+        a = m.generate(ids, max_new_tokens=5, temperature=0.0).numpy()
+        b = m.generate(ids, max_new_tokens=5, temperature=0.0,
+                       use_cache=False).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_sampling_reproducible_and_eos_stop(self):
+        m = self._model()
+        ids = np.random.RandomState(2).randint(0, 128, (2, 4)).astype(np.int32)
+        a = m.generate(ids, max_new_tokens=5, temperature=0.9, top_k=20,
+                       top_p=0.9, seed=7).numpy()
+        b = m.generate(ids, max_new_tokens=5, temperature=0.9, top_k=20,
+                       top_p=0.9, seed=7).numpy()
+        np.testing.assert_array_equal(a, b)
+        # eos stop: pick the greedy first token as "eos" → generation stops
+        g = m.generate(ids, max_new_tokens=8, temperature=0.0).numpy()
+        eos = int(g[0, 4])
+        e = m.generate(ids, max_new_tokens=8, temperature=0.0,
+                       eos_token_id=eos).numpy()
+        assert e.shape[1] <= g.shape[1]
+
+
+class TestMMHA:
+    def test_decode_steps_match_dense(self):
+        rng = np.random.RandomState(0)
+        B, H, D, Smax = 2, 2, 8, 16
+        cache = np.zeros((2, B, H, Smax, D), np.float32)
+        qs, ks, vs, outs = [], [], [], []
+        for t in range(3):
+            x = rng.randn(B, 3 * H * D).astype(np.float32)
+            s = x.reshape(B, 3, H, D)
+            qs.append(s[:, 0]); ks.append(s[:, 1]); vs.append(s[:, 2])
+            out, cache_t = IF.masked_multihead_attention(
+                paddle.to_tensor(x), paddle.to_tensor(cache),
+                sequence_lengths=paddle.to_tensor(np.full((B,), t, np.int32)))
+            cache = cache_t.numpy()
+            outs.append(out.numpy())
+        K = np.stack(ks, 2); V = np.stack(vs, 2)
+        logits = np.einsum("bhd,bhtd->bht", qs[2], K) / np.sqrt(D)
+        ref = np.einsum("bht,bhtd->bhd", _softmax(logits), V).reshape(B, H * D)
+        np.testing.assert_allclose(outs[2], ref, rtol=1e-5, atol=1e-5)
+
+    def test_quant_path_raises(self):
+        with pytest.raises(NotImplementedError):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(np.zeros((1, 48), np.float32)),
+                paddle.to_tensor(np.zeros((2, 1, 2, 4, 8), np.float32)),
+                out_scale=0.5)
+
+
+class TestBlockAttention:
+    def test_prefill_and_decode_match_dense(self):
+        rng = np.random.RandomState(0)
+        B, Hq, Hkv, D, bs = 2, 4, 2, 8, 4
+        kc = np.zeros((8, Hkv, bs, D), np.float32)
+        vc = np.zeros((8, Hkv, bs, D), np.float32)
+        tables = np.array([[0, 1, -1, -1], [2, 3, -1, -1]], np.int32)
+        S = 5
+        qkv = rng.randn(B, S, (Hq + 2 * Hkv) * D).astype(np.float32)
+        out, _, kc2, vc2 = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            paddle.to_tensor(np.zeros((B,), np.int32)),
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            block_tables=paddle.to_tensor(tables), block_size=bs)
+        q3 = qkv.reshape(B, S, Hq + 2 * Hkv, D)
+        q, k, v = q3[:, :, :Hq], q3[:, :, Hq:Hq + Hkv], q3[:, :, Hq + Hkv:]
+        kr, vr = np.repeat(k, 2, 2), np.repeat(v, 2, 2)
+        logits = np.einsum("bshd,bthd->bhst", q, kr) / np.sqrt(D)
+        logits = np.where(np.tril(np.ones((S, S), bool))[None, None], logits, -1e30)
+        ref = np.einsum("bhst,bthd->bshd", _softmax(logits), vr).reshape(B, S, Hq * D)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+        qkv_d = rng.randn(B, 1, (Hq + 2 * Hkv) * D).astype(np.float32)
+        out_d, _, _, _ = IF.block_multihead_attention(
+            paddle.to_tensor(qkv_d), kc2, vc2,
+            paddle.to_tensor(np.zeros((B,), np.int32)),
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            paddle.to_tensor(np.ones((B,), np.int32)),
+            block_tables=paddle.to_tensor(tables), block_size=bs)
+        qd3 = qkv_d.reshape(B, 1, Hq + 2 * Hkv, D)
+        qd = qd3[:, :, :Hq]
+        k_all = np.concatenate([k, qd3[:, :, Hq:Hq + Hkv]], 1)
+        v_all = np.concatenate([v, qd3[:, :, Hq + Hkv:]], 1)
+        kr, vr = np.repeat(k_all, 2, 2), np.repeat(v_all, 2, 2)
+        logits = np.einsum("bshd,bthd->bhst", qd, kr) / np.sqrt(D)
+        ref_d = np.einsum("bhst,bthd->bshd", _softmax(logits), vr).reshape(B, 1, Hq * D)
+        np.testing.assert_allclose(out_d.numpy(), ref_d, rtol=1e-5, atol=1e-5)
+
+    def test_blha_get_max_len(self):
+        e, d = IF.blha_get_max_len(
+            paddle.to_tensor(np.array([3, 9, 1], np.int32)),
+            paddle.to_tensor(np.array([5, 2, 8], np.int32)))
+        assert int(e.numpy()[0]) == 9 and int(d.numpy()[0]) == 8
+
+
+class TestVarlenAttention:
+    def test_masks_padding(self):
+        rng = np.random.RandomState(1)
+        B, H, S, D = 2, 2, 8, 4
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        k = rng.randn(B, H, S, D).astype(np.float32)
+        v = rng.randn(B, H, S, D).astype(np.float32)
+        lens = np.array([8, 5], np.int32)
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(lens), paddle.to_tensor(lens)).numpy()
+        # row 1 must ignore keys >= 5
+        logits = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(D)
+        logits[1, :, :, 5:] = -1e30
+        ref = np.einsum("bhst,bhtd->bhsd", _softmax(logits), v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestFusedMultiTransformer:
+    def test_cached_decode_matches_full(self):
+        rng = np.random.RandomState(0)
+        paddle.seed(3)
+        B = 2
+        fmt = FusedMultiTransformer(embed_dim=16, num_heads=2,
+                                    dim_feedforward=32, num_layers=2)
+        for p_ in fmt.parameters():
+            p_.set_value(paddle.to_tensor(
+                rng.randn(*p_.shape).astype(np.float32) * 0.05))
+        src = rng.randn(B, 6, 16).astype(np.float32)
+        full = fmt(paddle.to_tensor(src)).numpy()
+        caches = fmt.init_caches(B, 8)
+        _, caches = fmt(paddle.to_tensor(src[:, :5]), caches=caches, time_step=0)
+        h2, _ = fmt(paddle.to_tensor(src[:, 5:6]), caches=caches, time_step=5)
+        np.testing.assert_allclose(h2.numpy()[:, 0], full[:, 5],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gqa_and_rmsnorm(self):
+        rng = np.random.RandomState(1)
+        fmt = FusedMultiTransformer(embed_dim=16, num_heads=4,
+                                    dim_feedforward=32, num_layers=1,
+                                    norm_type="rmsnorm", gqa_group_size=2)
+        assert fmt.kv_heads == 2
+        out = fmt(paddle.to_tensor(rng.randn(1, 4, 16).astype(np.float32)))
+        assert out.shape == [1, 4, 16]
+
+
+class TestSavedProgram:
+    def test_jit_save_load_predictor(self, tmp_path):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                        num_heads=2, max_position_embeddings=32)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = np.random.RandomState(0).randint(0, 64, (2, 8)).astype(np.int32)
+        ref = m(paddle.to_tensor(ids)).numpy()
+        prefix = os.path.join(str(tmp_path), "gpt")
+        paddle.jit.save(m, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 8], "int32")])
+        assert os.path.exists(prefix + ".pdmodel")
+        tl = paddle.jit.load(prefix)
+        np.testing.assert_allclose(tl(paddle.to_tensor(ids)).numpy(), ref,
+                                   rtol=1e-6, atol=1e-6)
+        config = paddle.inference.Config(prefix + ".pdmodel")
+        pred = paddle.inference.create_predictor(config)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(ids)
+        outs = pred.run()
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-6, atol=1e-6)
