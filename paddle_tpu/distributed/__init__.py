@@ -48,8 +48,22 @@ from .env import (
 )
 from .parallel import DataParallel, group_sharded_parallel
 from .train_step import DistributedTrainStep
+from . import auto_parallel, checkpoint
+from .auto_parallel import (
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)
 
 __all__ = [
+    "auto_parallel", "checkpoint", "ProcessMesh", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer", "shard_optimizer",
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "is_initialized", "build_mesh", "new_group", "get_group", "ReduceOp",
     "all_reduce", "all_gather", "all_gather_object", "reduce",

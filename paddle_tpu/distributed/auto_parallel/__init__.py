@@ -1,0 +1,18 @@
+from .api import (  # noqa: F401
+    Partial,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+
+__all__ = [
+    "ProcessMesh", "get_mesh", "set_mesh",
+    "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "dtensor_from_fn",
+    "shard_layer", "shard_optimizer",
+]

@@ -1,0 +1,201 @@
+"""Semi-auto parallel API — shard_tensor / reshard / placements.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor :220,
+reshard :796, to_static :2946) + placements
+(python/paddle/distributed/auto_parallel/placement_type.py) + C++ DistTensor
+(paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39) with 160
+registered SPMD rules and the reshard function library
+(paddle/phi/core/distributed/auto_parallel/reshard/).
+
+TPU-native collapse: a "DistTensor" is a jax.Array with a NamedSharding — the
+SPMD rule library IS GSPMD (XLA propagates shardings through every op), and
+every reshard pair (p2r/r2p/s2r/nd-mesh...) is jax.device_put to the new
+sharding, which XLA lowers to the right collective. Eager ops between dist
+tensors run distributed automatically (jax computation-follows-sharding),
+which is exactly the reference's dygraph semi-auto semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.core import Tensor, to_tensor
+from .process_mesh import ProcessMesh
+
+__all__ = ["Shard", "Replicate", "Partial", "shard_tensor", "reshard",
+           "dtensor_from_fn", "shard_layer", "shard_optimizer"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Shard tensor dim `dim` over the corresponding mesh axis
+    (reference: paddle.distributed.Shard)."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement (reference: paddle.distributed.Partial).
+    NamedSharding cannot express partial values; tensors carry it as metadata
+    and materialize replicated — reshard(Partial→Replicate/Shard) is where
+    the reduction would fire (GSPMD emits it inside jit; eagerly the value is
+    already the full sum because eager ops never produce partials here)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+def _to_spec(placements, ndim, mesh: ProcessMesh):
+    """placements (one per mesh axis) -> PartitionSpec over tensor dims."""
+    entries = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            name = mesh.dim_names[axis_idx]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return P(*entries)
+
+
+def _placed(value, mesh: ProcessMesh, placements):
+    jm = mesh.jax_mesh()
+    spec = _to_spec(placements, np.ndim(value), mesh)
+    return jax.device_put(value, NamedSharding(jm, spec))
+
+
+def _attach(t: Tensor, mesh, placements):
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    t.dist_attr = _to_spec(placements, len(t.shape), mesh)
+    t.is_dist_tensor = True
+    return t
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """reference: api.py:220 — build a dist tensor from data + placements."""
+    t = data if isinstance(data, Tensor) else to_tensor(data, dtype=dtype)
+    if len(placements) != mesh.ndim:
+        raise ValueError(
+            f"need one placement per mesh dim ({mesh.ndim}), got {len(placements)}")
+    out = Tensor(_placed(t._value, mesh, placements),
+                 stop_gradient=t.stop_gradient if stop_gradient is None else stop_gradient)
+    return _attach(out, mesh, placements)
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """reference: api.py:796 + the C++ reshard function library — here one
+    device_put: XLA/runtime picks the collective (all-gather for s2r,
+    slice for r2s, all-to-all for cross-dim moves)."""
+    t = dist_tensor if isinstance(dist_tensor, Tensor) else to_tensor(dist_tensor)
+    out = Tensor(_placed(t._value, mesh, placements), stop_gradient=t.stop_gradient)
+    return _attach(out, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    """reference: api.py dtensor_from_fn — build then place."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """reference: api.py shard_layer — apply shard_fn(sublayer_name, layer,
+    mesh) to every sublayer; default replicates every parameter."""
+
+    def default_fn(name, l, mesh):
+        for pname, p in l._parameters.items():
+            if p is None:
+                continue
+            placed = shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+            p._value = placed._value
+            _attach(p, mesh, placed.placements)
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+class _ShardOptimizer:
+    """reference: api.py shard_optimizer — optimizer whose states follow the
+    parameter placements. Eagerly the states are created from the (already
+    placed) params, so moment tensors inherit shardings automatically; this
+    wrapper exists for API parity and master-weight pass-through."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        return self._inner.step()
+
+    def clear_grad(self, *a, **kw):
+        return self._inner.clear_grad(*a, **kw)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return _ShardOptimizer(optimizer, shard_fn)

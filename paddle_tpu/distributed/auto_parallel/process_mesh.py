@@ -1,0 +1,92 @@
+"""ProcessMesh — the auto-parallel device mesh.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py:85
+(ProcessMesh holds an N-D array of process ranks + dim names; every dist
+tensor/op carries one).
+
+TPU-native: a ProcessMesh IS a jax.sharding.Mesh over the local devices —
+"process ids" index jax.devices(). The global default mesh (set_mesh) is what
+`shard_tensor` uses when placements reference it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_global_mesh = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is None and shape is not None:
+            mesh = np.asarray(process_ids or range(int(np.prod(shape)))).reshape(shape)
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        assert arr.ndim == len(dim_names)
+        self._mesh = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._mesh.flatten().tolist()
+
+    def get_dim_size(self, name):
+        return self._mesh.shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._mesh, other._mesh))
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._mesh.tobytes()))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def get_group(self, dim_name=None):
+        from .. import collective as coll
+
+        return coll.get_group(0)
+
+    def jax_mesh(self):
+        """Materialize as a jax Mesh (devices indexed by process id)."""
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            picked = np.asarray([devs[i % len(devs)] for i in self._mesh.flatten()],
+                                dtype=object).reshape(self._mesh.shape)
+            self._jax_mesh = Mesh(picked, tuple(self._dim_names))
+        return self._jax_mesh
+
+
+def set_mesh(mesh: ProcessMesh):
+    """reference: paddle.distributed.auto_parallel.set_mesh."""
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh

@@ -1,0 +1,66 @@
+"""Checkpoint metadata: the map from global tensors to on-disk shards.
+
+Reference: python/paddle/distributed/checkpoint/metadata.py — Metadata holds
+{state_name: [LocalTensorMetadata]} where each local shard records its global
+offset + local shape + the file that stores it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+@dataclasses.dataclass
+class LocalTensorMetadata:
+    global_offset: tuple  # start index of this shard in the global tensor
+    local_shape: tuple
+    dtype: str
+    file_name: str
+    key: str  # key inside the shard file
+
+
+@dataclasses.dataclass
+class LocalTensorIndex:
+    tensor_key: str
+    global_offset: tuple
+
+
+@dataclasses.dataclass
+class Metadata:
+    state_dict_metadata: dict  # name -> [LocalTensorMetadata]
+    global_shapes: dict        # name -> tuple
+    flat_mapping: dict = dataclasses.field(default_factory=dict)
+
+    def save(self, path):
+        payload = {
+            "state_dict_metadata": {
+                k: [dataclasses.asdict(m) for m in v]
+                for k, v in self.state_dict_metadata.items()
+            },
+            "global_shapes": {k: list(v) for k, v in self.global_shapes.items()},
+            "flat_mapping": self.flat_mapping,
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f)
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            payload = json.load(f)
+        return cls(
+            state_dict_metadata={
+                k: [LocalTensorMetadata(
+                    tuple(m["global_offset"]), tuple(m["local_shape"]),
+                    m["dtype"], m["file_name"], m["key"])
+                    for m in v]
+                for k, v in payload["state_dict_metadata"].items()
+            },
+            global_shapes={k: tuple(v) for k, v in payload["global_shapes"].items()},
+            flat_mapping=payload.get("flat_mapping", {}),
+        )
+
+
+def metadata_path(dirname):
+    return os.path.join(dirname, "0.metadata")

@@ -269,6 +269,11 @@ class Tensor:
         "_hooks",
         "_retain_grads",
         "name",
+        # semi-auto parallel metadata (distributed/auto_parallel/api.py)
+        "process_mesh",
+        "placements",
+        "dist_attr",
+        "is_dist_tensor",
         "__weakref__",
     )
 
@@ -283,6 +288,11 @@ class Tensor:
         self._hooks = None
         self._retain_grads = False
         self.name = name
+        # semi-auto parallel metadata (distributed/auto_parallel/api.py _attach)
+        self.process_mesh = None
+        self.placements = None
+        self.dist_attr = None
+        self.is_dist_tensor = False
 
     # -- basic metadata ---------------------------------------------------- #
 
@@ -545,7 +555,7 @@ class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase);
     stop_gradient defaults to False and it carries a trainable flag."""
 
-    __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer", "need_clip", "dist_attr")
+    __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer", "need_clip")
 
     def __init__(self, value, trainable: bool = True, name: str | None = None):
         super().__init__(value, stop_gradient=not trainable, name=name)
