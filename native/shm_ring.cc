@@ -1,0 +1,217 @@
+// Shared-memory ring buffer for DataLoader worker -> trainer batch transfer.
+//
+// Reference: the reference moves batches from multiprocess workers through
+// shared memory (python/paddle/io/dataloader/worker.py + its C++ data_feed,
+// paddle/fluid/framework/data_feed.cc) to avoid pickling tensors through
+// pipes.
+//
+// Design: one POSIX shm segment = [Header | data]; variable-size records
+// ([u64 len][payload]) in a circular byte buffer; process-shared mutex +
+// condvars for blocking push/pop. Single producer / single consumer per ring
+// (DataLoader uses one ring per worker, reading round-robin).
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;  // data bytes
+  uint64_t head;      // read offset
+  uint64_t tail;      // write offset
+  uint64_t used;      // bytes in buffer
+  uint32_t closed;
+};
+
+struct Ring {
+  Header* h;
+  char* data;
+  uint64_t capacity;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+void write_bytes(Ring* r, const char* src, uint64_t n) {
+  uint64_t tail = r->h->tail;
+  uint64_t first = std::min(n, r->capacity - tail);
+  memcpy(r->data + tail, src, first);
+  if (n > first) memcpy(r->data, src + first, n - first);
+  r->h->tail = (tail + n) % r->capacity;
+  r->h->used += n;
+}
+
+void read_bytes(Ring* r, char* dst, uint64_t n) {
+  uint64_t head = r->h->head;
+  uint64_t first = std::min(n, r->capacity - head);
+  memcpy(dst, r->data + head, first);
+  if (n > first) memcpy(dst + first, r->data, n - first);
+  r->h->head = (head + n) % r->capacity;
+  r->h->used -= n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shm_ring_create(const char* name, long capacity) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = sizeof(Header) + static_cast<uint64_t>(capacity);
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = static_cast<Header*>(mem);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_empty, &ca);
+  pthread_cond_init(&h->not_full, &ca);
+  h->capacity = static_cast<uint64_t>(capacity);
+  h->head = h->tail = h->used = 0;
+  h->closed = 0;
+  auto* r = new Ring();
+  r->h = h;
+  r->data = static_cast<char*>(mem) + sizeof(Header);
+  r->capacity = h->capacity;
+  r->fd = fd;
+  r->owner = true;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  return r;
+}
+
+void* shm_ring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* h = static_cast<Header*>(mem);
+  auto* r = new Ring();
+  r->h = h;
+  r->data = static_cast<char*>(mem) + sizeof(Header);
+  r->capacity = h->capacity;
+  r->fd = fd;
+  r->owner = false;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  return r;
+}
+
+// push one record; blocks while full. returns 0 ok, -1 closed, -2 too large
+int shm_ring_push(void* rp, const void* buf, long n) {
+  auto* r = static_cast<Ring*>(rp);
+  uint64_t need = 8 + static_cast<uint64_t>(n);
+  if (need > r->capacity) return -2;
+  pthread_mutex_lock(&r->h->mu);
+  while (r->capacity - r->h->used < need && !r->h->closed)
+    pthread_cond_wait(&r->h->not_full, &r->h->mu);
+  if (r->h->closed) {
+    pthread_mutex_unlock(&r->h->mu);
+    return -1;
+  }
+  uint64_t len = static_cast<uint64_t>(n);
+  write_bytes(r, reinterpret_cast<const char*>(&len), 8);
+  write_bytes(r, static_cast<const char*>(buf), len);
+  pthread_cond_signal(&r->h->not_empty);
+  pthread_mutex_unlock(&r->h->mu);
+  return 0;
+}
+
+// pop one record into buf (cap bytes); blocks while empty.
+// returns record length, -1 closed+drained, -2 buffer too small (record kept)
+long shm_ring_pop(void* rp, void* buf, long cap) {
+  auto* r = static_cast<Ring*>(rp);
+  pthread_mutex_lock(&r->h->mu);
+  while (r->h->used == 0 && !r->h->closed)
+    pthread_cond_wait(&r->h->not_empty, &r->h->mu);
+  if (r->h->used == 0 && r->h->closed) {
+    pthread_mutex_unlock(&r->h->mu);
+    return -1;
+  }
+  uint64_t len;
+  uint64_t head = r->h->head;  // peek
+  uint64_t first = std::min<uint64_t>(8, r->capacity - head);
+  memcpy(&len, r->data + head, first);
+  if (first < 8)
+    memcpy(reinterpret_cast<char*>(&len) + first, r->data, 8 - first);
+  if (static_cast<long>(len) > cap) {
+    pthread_mutex_unlock(&r->h->mu);
+    return -2;
+  }
+  read_bytes(r, reinterpret_cast<char*>(&len), 8);  // consume header
+  read_bytes(r, static_cast<char*>(buf), len);
+  pthread_cond_signal(&r->h->not_full);
+  pthread_mutex_unlock(&r->h->mu);
+  return static_cast<long>(len);
+}
+
+// non-blocking size probe of next record (-1 if empty)
+long shm_ring_peek(void* rp) {
+  auto* r = static_cast<Ring*>(rp);
+  pthread_mutex_lock(&r->h->mu);
+  long out = -1;
+  if (r->h->used >= 8) {
+    uint64_t len;
+    uint64_t head = r->h->head;
+    uint64_t first = std::min<uint64_t>(8, r->capacity - head);
+    memcpy(&len, r->data + head, first);
+    if (first < 8)
+      memcpy(reinterpret_cast<char*>(&len) + first, r->data, 8 - first);
+    out = static_cast<long>(len);
+  }
+  pthread_mutex_unlock(&r->h->mu);
+  return out;
+}
+
+void shm_ring_close(void* rp) {
+  auto* r = static_cast<Ring*>(rp);
+  pthread_mutex_lock(&r->h->mu);
+  r->h->closed = 1;
+  pthread_cond_broadcast(&r->h->not_empty);
+  pthread_cond_broadcast(&r->h->not_full);
+  pthread_mutex_unlock(&r->h->mu);
+}
+
+void shm_ring_destroy(void* rp) {
+  auto* r = static_cast<Ring*>(rp);
+  bool owner = r->owner;
+  uint64_t total = sizeof(Header) + r->capacity;
+  munmap(r->h, total);
+  ::close(r->fd);
+  if (owner) shm_unlink(r->name);
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,260 @@
+// TCPStore: TCP key-value rendezvous store.
+//
+// Reference: paddle/phi/core/distributed/store/tcp_store.h:121 +
+// tcp_utils.cc — rank 0 hosts a socket server with a string->bytes map;
+// clients SET/GET/ADD/WAIT keys to bootstrap process groups.
+//
+// TPU-native runtime keeps the same role (multi-host bootstrap before
+// jax.distributed is up, barrier/elastic bookkeeping). Thread-per-connection
+// server, blocking WAIT via condition variable, length-prefixed frames:
+//   request:  [u8 op][u32 klen][key][u64 vlen][val]
+//   response: [u64 vlen][val]   (ADD returns 8-byte little-endian i64)
+// ops: 1=SET 2=GET(blocking until key exists, bounded by client timeout)
+//      3=ADD 4=WAIT 5=DELETE
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  bool stopping = false;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_blob(int fd, const std::string& v) {
+  uint64_t n = v.size();
+  if (!write_full(fd, &n, 8)) return false;
+  return v.empty() ? true : write_full(fd, v.data(), v.size());
+}
+
+void handle_conn(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    uint32_t klen;
+    uint64_t vlen;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 8)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_full(fd, &val[0], vlen)) break;
+
+    if (op == 1) {  // SET
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->kv[key] = val;
+      }
+      s->cv.notify_all();
+      if (!send_blob(fd, "")) break;
+    } else if (op == 2 || op == 4) {  // GET (blocking) / WAIT
+      std::unique_lock<std::mutex> g(s->mu);
+      s->cv.wait(g, [&] { return s->stopping || s->kv.count(key); });
+      if (s->stopping) break;
+      std::string out = (op == 2) ? s->kv[key] : "";
+      g.unlock();
+      if (!send_blob(fd, op == 2 ? out : std::string("\x01", 1))) break;
+    } else if (op == 3) {  // ADD
+      int64_t delta = 0;
+      memcpy(&delta, val.data(), std::min<size_t>(8, val.size()));
+      int64_t now;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        int64_t cur = 0;
+        auto it = s->kv.find(key);
+        if (it != s->kv.end())
+          memcpy(&cur, it->second.data(), std::min<size_t>(8, it->second.size()));
+        now = cur + delta;
+        s->kv[key] = std::string(reinterpret_cast<char*>(&now), 8);
+      }
+      s->cv.notify_all();
+      if (!send_blob(fd, std::string(reinterpret_cast<char*>(&now), 8))) break;
+    } else if (op == 5) {  // DELETE
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->kv.erase(key);
+      }
+      if (!send_blob(fd, "")) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] {
+    for (;;) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen fd closed -> shutdown
+      std::lock_guard<std::mutex> g(s->mu);
+      if (s->stopping) {
+        ::close(cfd);
+        break;
+      }
+      s->conns.emplace_back(handle_conn, s, cfd);
+    }
+  });
+  return s;
+}
+
+int tcp_store_server_port(void* sp) {
+  auto* s = static_cast<Server*>(sp);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void tcp_store_server_stop(void* sp) {
+  auto* s = static_cast<Server*>(sp);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->stopping = true;
+  }
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->conns)
+    if (t.joinable()) t.detach();  // blocked conns die with process
+  delete s;
+}
+
+intptr_t tcp_store_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 3000);
+  while (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    if (std::chrono::steady_clock::now() > deadline) {
+      ::close(fd);
+      return -1;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static int request(int fd, uint8_t op, const char* key, const void* val,
+                   uint64_t vlen, std::string* out) {
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!write_full(fd, &op, 1) || !write_full(fd, &klen, 4) ||
+      !write_full(fd, key, klen) || !write_full(fd, &vlen, 8))
+    return -1;
+  if (vlen && !write_full(fd, val, vlen)) return -1;
+  uint64_t rlen;
+  if (!read_full(fd, &rlen, 8)) return -1;
+  out->resize(rlen);
+  if (rlen && !read_full(fd, &(*out)[0], rlen)) return -1;
+  return 0;
+}
+
+int tcp_store_set(intptr_t fd, const char* key, const void* val, long vlen) {
+  std::string out;
+  return request(static_cast<int>(fd), 1, key, val,
+                 static_cast<uint64_t>(vlen), &out);
+}
+
+long tcp_store_get(intptr_t fd, const char* key, void* buf, long cap) {
+  std::string out;
+  if (request(static_cast<int>(fd), 2, key, nullptr, 0, &out) != 0) return -1;
+  long n = static_cast<long>(out.size());
+  memcpy(buf, out.data(), std::min<long>(n, cap));
+  return n;
+}
+
+long long tcp_store_add(intptr_t fd, const char* key, long long delta) {
+  std::string out;
+  if (request(static_cast<int>(fd), 3, key, &delta, 8, &out) != 0 ||
+      out.size() < 8)
+    return -1;
+  long long v;
+  memcpy(&v, out.data(), 8);
+  return v;
+}
+
+int tcp_store_wait(intptr_t fd, const char* key) {
+  std::string out;
+  return request(static_cast<int>(fd), 4, key, nullptr, 0, &out);
+}
+
+int tcp_store_delete(intptr_t fd, const char* key) {
+  std::string out;
+  return request(static_cast<int>(fd), 5, key, nullptr, 0, &out);
+}
+
+void tcp_store_close(intptr_t fd) { ::close(static_cast<int>(fd)); }
+
+}  // extern "C"

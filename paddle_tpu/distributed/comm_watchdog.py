@@ -1,0 +1,90 @@
+"""Collective hang watchdog — native monitor thread flagging stuck steps.
+
+Reference: CommTaskManager (paddle/phi/core/distributed/comm_task_manager.h:37)
+with per-collective timeout tracking (comm_task.h:127 IsTimeout) — the
+practical distributed deadlock detector.
+
+TPU-native: collectives are compiled into programs, so the tracked unit is a
+blocking region (a dispatched train step, an eager collective, a host sync).
+Wrap suspect regions in `comm_task(...)`; the native thread
+(native/watchdog.cc) flags any region exceeding its deadline and the report
+surfaces on the next poll — exactly the "log stuck rings" behavior.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import os
+import threading
+
+from ..framework import native
+
+__all__ = ["enable", "disable", "comm_task", "drain_report", "timeout_count",
+           "inflight"]
+
+_wd = None
+_lock = threading.Lock()
+
+
+def enable(timeout_seconds=None):
+    """Start the watchdog (idempotent). Default timeout from
+    FLAGS_pg_timeout-equivalent env PADDLE_PG_TIMEOUT (seconds, default 1800)."""
+    global _wd
+    with _lock:
+        if _wd is not None:
+            return True
+        lib = native.load()
+        if lib is None:
+            return False
+        if timeout_seconds is None:
+            timeout_seconds = float(os.environ.get("PADDLE_PG_TIMEOUT", "1800"))
+        _wd = (lib, lib.watchdog_create(int(timeout_seconds * 1000)))
+        return True
+
+
+def disable():
+    global _wd
+    with _lock:
+        if _wd is not None:
+            lib, h = _wd
+            lib.watchdog_destroy(h)
+            _wd = None
+
+
+@contextlib.contextmanager
+def comm_task(desc: str, timeout_seconds=None):
+    """Track a blocking region; no-op when the watchdog is off."""
+    if _wd is None:
+        yield
+        return
+    lib, h = _wd
+    tid = lib.watchdog_register(h, desc.encode(),
+                                int((timeout_seconds or 0) * 1000))
+    try:
+        yield
+    finally:
+        lib.watchdog_complete(h, tid)
+
+
+def drain_report() -> str:
+    if _wd is None:
+        return ""
+    lib, h = _wd
+    buf = ctypes.create_string_buffer(1 << 16)
+    n = lib.watchdog_drain_report(h, buf, len(buf))
+    return buf.raw[:n].decode(errors="replace")
+
+
+def timeout_count() -> int:
+    if _wd is None:
+        return 0
+    lib, h = _wd
+    return int(lib.watchdog_timeout_count(h))
+
+
+def inflight() -> int:
+    if _wd is None:
+        return 0
+    lib, h = _wd
+    return int(lib.watchdog_inflight(h))

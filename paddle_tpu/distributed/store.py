@@ -1,0 +1,129 @@
+"""TCPStore — the rendezvous key-value store, backed by the native C++ server
+(native/tcp_store.cc; reference: paddle/phi/core/distributed/store/
+tcp_store.h:121 and python create_or_get_global_tcp_store,
+python/paddle/distributed/collective.py:342).
+
+The master rank hosts the server; every rank (master included) connects as a
+client. Used for multi-host bootstrap (before jax.distributed is up),
+barriers, and elastic bookkeeping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..framework import native
+
+__all__ = ["TCPStore", "create_or_get_global_tcp_store"]
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=30):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError(
+                "native runtime unavailable (g++ build failed) — TCPStore "
+                "requires native/libpaddle_tpu_native.so")
+        self._lib = lib
+        self._server = None
+        self._host = host
+        self._timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = lib.tcp_store_server_start(int(port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.tcp_store_server_port(self._server)
+        self._port = int(port)
+        self._fd = lib.tcp_store_connect(host.encode(), self._port,
+                                         self._timeout_ms)
+        if self._fd < 0:
+            if self._server:
+                lib.tcp_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+        self._lock = threading.Lock()
+
+    @property
+    def port(self):
+        return self._port
+
+    def set(self, key: str, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            rc = self._lib.tcp_store_set(self._fd, key.encode(), value, len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        import ctypes
+
+        buf = ctypes.create_string_buffer(1 << 20)
+        with self._lock:
+            n = self._lib.tcp_store_get(self._fd, key.encode(), buf, len(buf))
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int) -> int:
+        with self._lock:
+            v = self._lib.tcp_store_add(self._fd, key.encode(), int(amount))
+        if v == -1:
+            raise RuntimeError("TCPStore.add failed")
+        return int(v)
+
+    def wait(self, keys):
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            with self._lock:
+                rc = self._lib.tcp_store_wait(self._fd, k.encode())
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.wait({k}) failed")
+
+    def delete_key(self, key: str):
+        with self._lock:
+            self._lib.tcp_store_delete(self._fd, key.encode())
+
+    def barrier(self, prefix: str, world_size: int, rank: int):
+        """Counter barrier: every rank adds 1, waits for the done key."""
+        n = self.add(f"{prefix}/count", 1)
+        if n >= world_size:
+            self.set(f"{prefix}/done", b"1")
+        self.wait(f"{prefix}/done")
+
+    def close(self):
+        if self._fd >= 0:
+            self._lib.tcp_store_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.tcp_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_global_store = None
+
+
+def create_or_get_global_tcp_store():
+    """reference: python/paddle/distributed/collective.py:342 — master from
+    PADDLE_MASTER / MASTER_ADDR:PORT envs, rank 0 hosts."""
+    global _global_store
+    if _global_store is not None:
+        return _global_store
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR", "127.0.0.1")
+    if ":" in master:
+        host, port = master.rsplit(":", 1)
+        port = int(port)
+    else:
+        host, port = master, int(os.environ.get("MASTER_PORT", "6170"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+    _global_store = TCPStore(host, port, is_master=(rank == 0), world_size=world)
+    return _global_store

@@ -1,0 +1,107 @@
+"""Loader for the native C++ runtime library (native/*.cc).
+
+Reference analogs: the pybind layer (paddle/fluid/pybind/) binding phi's C++
+runtime into python. Here the runtime pieces that must be native (socket
+rendezvous, watchdog thread, shm transport) live in
+libpaddle_tpu_native.so, bound via ctypes; everything compute-side is XLA.
+
+The library is built lazily with `make -C native` on first use and cached;
+all consumers degrade gracefully (pure-python fallbacks) when no compiler
+is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lib = None
+_lock = threading.Lock()
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO = os.path.join(_NATIVE_DIR, "libpaddle_tpu_native.so")
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load():
+    """Return the ctypes lib, building it if needed; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # tcp store
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [ctypes.c_int]
+        lib.tcp_store_server_port.restype = ctypes.c_int
+        lib.tcp_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_connect.restype = ctypes.c_ssize_t
+        lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.tcp_store_set.restype = ctypes.c_int
+        lib.tcp_store_set.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_long]
+        lib.tcp_store_get.restype = ctypes.c_long
+        lib.tcp_store_get.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_long]
+        lib.tcp_store_add.restype = ctypes.c_longlong
+        lib.tcp_store_add.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
+                                      ctypes.c_longlong]
+        lib.tcp_store_wait.restype = ctypes.c_int
+        lib.tcp_store_wait.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p]
+        lib.tcp_store_delete.restype = ctypes.c_int
+        lib.tcp_store_delete.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p]
+        lib.tcp_store_close.argtypes = [ctypes.c_ssize_t]
+        # watchdog
+        lib.watchdog_create.restype = ctypes.c_void_p
+        lib.watchdog_create.argtypes = [ctypes.c_long]
+        lib.watchdog_destroy.argtypes = [ctypes.c_void_p]
+        lib.watchdog_register.restype = ctypes.c_longlong
+        lib.watchdog_register.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_long]
+        lib.watchdog_complete.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.watchdog_timeout_count.restype = ctypes.c_longlong
+        lib.watchdog_timeout_count.argtypes = [ctypes.c_void_p]
+        lib.watchdog_drain_report.restype = ctypes.c_long
+        lib.watchdog_drain_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                              ctypes.c_long]
+        lib.watchdog_inflight.restype = ctypes.c_longlong
+        lib.watchdog_inflight.argtypes = [ctypes.c_void_p]
+        # shm ring
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        lib.shm_ring_attach.restype = ctypes.c_void_p
+        lib.shm_ring_attach.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_long]
+        lib.shm_ring_pop.restype = ctypes.c_long
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_long]
+        lib.shm_ring_peek.restype = ctypes.c_long
+        lib.shm_ring_peek.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -298,12 +298,18 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 worker_mode="thread", shm_capacity=64 << 20):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        # "process": forked workers + native shared-memory rings (the
+        # reference's worker.py/data_feed transport); "thread": GIL-dropping
+        # numpy pipeline, the TPU default
+        self.worker_mode = worker_mode
+        self.shm_capacity = shm_capacity
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -338,6 +344,9 @@ class DataLoader:
         if self.num_workers <= 0:
             for idx_batch in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
+            return
+        if self.worker_mode == "process":
+            yield from self._iter_processes()
             return
         yield from self._iter_threaded()
 
@@ -381,3 +390,58 @@ class DataLoader:
                 emitted += 1
         for t in threads:
             t.join(timeout=1)
+
+    def _iter_processes(self):
+        """Forked workers pushing collated batches through native shm rings
+        (io/shm_channel.py; reference: io/dataloader/worker.py). Worker w
+        handles batches w, w+W, ... so per-ring FIFO = global batch order."""
+        import multiprocessing as mp
+        import os as _os
+
+        from .shm_channel import ShmRing
+
+        idx_batches = list(self.batch_sampler)
+        W = self.num_workers
+        rings = [ShmRing.create(self.shm_capacity) for _ in range(W)]
+        ctx = mp.get_context("fork")
+
+        def worker(wid, ring_name, batches):
+            ring = ShmRing.attach(ring_name)
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            _worker_info.info = type("WorkerInfo", (), {
+                "id": wid, "num_workers": W, "dataset": self.dataset})()
+            try:
+                for b in batches:
+                    try:
+                        data = self.collate_fn([self.dataset[j] for j in b])
+                        ring.push(("ok", data))
+                    except BaseException as e:
+                        ring.push(("err", repr(e)))
+                        return
+            except EOFError:
+                pass
+            _os._exit(0)
+
+        procs = []
+        for w in range(W):
+            batches = idx_batches[w::W]
+            p = ctx.Process(target=worker, args=(w, rings[w].name, batches),
+                            daemon=True)
+            p.start()
+            procs.append(p)
+        try:
+            for i in range(len(idx_batches)):
+                tag, data = rings[i % W].pop()
+                if tag == "err":
+                    raise RuntimeError(f"DataLoader worker failed: {data}")
+                yield data
+        finally:
+            for r in rings:
+                r.close()
+            for p in procs:
+                p.join(timeout=2)
+                if p.is_alive():
+                    p.terminate()
+            for r in rings:
+                r.destroy()

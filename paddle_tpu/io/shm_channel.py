@@ -1,0 +1,96 @@
+"""Shared-memory batch channel for process-mode DataLoader workers.
+
+Reference: python/paddle/io/dataloader/worker.py + the C++ shared-memory
+transport (data_feed.cc) — batches cross the worker→trainer process boundary
+through shared memory, not pipe pickling.
+
+Backed by the native ring (native/shm_ring.cc): variable-size records in one
+POSIX shm segment with process-shared mutex/condvars. One ring per worker;
+the parent pops rings round-robin so per-ring FIFO order gives global batch
+order without index headers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+
+from ..framework import native
+
+__all__ = ["ShmRing"]
+
+
+class ShmRing:
+    def __init__(self, handle, lib, name, owner):
+        self._h = handle
+        self._lib = lib
+        self._name = name
+        self._owner = owner
+
+    @classmethod
+    def create(cls, capacity=64 << 20, name=None):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable — shm ring needs "
+                               "native/libpaddle_tpu_native.so")
+        name = name or f"/pdtpu_ring_{os.getpid()}_{id(object()) & 0xFFFFFF:x}"
+        h = lib.shm_ring_create(name.encode(), int(capacity))
+        if not h:
+            raise RuntimeError(f"shm_ring_create({name}) failed")
+        return cls(h, lib, name, owner=True)
+
+    @classmethod
+    def attach(cls, name):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        h = lib.shm_ring_attach(name.encode())
+        if not h:
+            raise RuntimeError(f"shm_ring_attach({name}) failed")
+        return cls(h, lib, name, owner=False)
+
+    @property
+    def name(self):
+        return self._name
+
+    def push(self, obj):
+        """Blocking push of one pickled record. Raises on closed ring."""
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._lib.shm_ring_push(self._h, payload, len(payload))
+        if rc == -2:
+            raise ValueError(
+                f"record of {len(payload)} bytes exceeds ring capacity; "
+                "raise DataLoader shm_capacity")
+        if rc != 0:
+            raise EOFError("ring closed")
+
+    def pop(self):
+        """Blocking pop; returns the object or raises EOFError when closed+empty."""
+        n = self._lib.shm_ring_peek(self._h)
+        cap = max(n, 1 << 16)
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.shm_ring_pop(self._h, buf, cap)
+            if n == -1:
+                raise EOFError("ring closed")
+            if n == -2:
+                cap *= 4
+                continue
+            return pickle.loads(buf.raw[:n])
+
+    def close(self):
+        if self._h:
+            self._lib.shm_ring_close(self._h)
+
+    def destroy(self):
+        if self._h:
+            self._lib.shm_ring_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            if self._owner:
+                self.destroy()
+        except Exception:
+            pass
