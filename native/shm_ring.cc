@@ -66,7 +66,9 @@ void read_bytes(Ring* r, char* dst, uint64_t n) {
 extern "C" {
 
 void* shm_ring_create(const char* name, long capacity) {
-  shm_unlink(name);
+  // O_EXCL without a pre-unlink: a name collision (two rings generating the
+  // same name) must fail loudly rather than silently unlinking the segment
+  // another worker is attached to.
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
   uint64_t total = sizeof(Header) + static_cast<uint64_t>(capacity);

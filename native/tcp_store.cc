@@ -34,6 +34,7 @@ struct Server {
   int listen_fd = -1;
   std::thread accept_thread;
   std::vector<std::thread> conns;
+  std::vector<int> conn_fds;  // guarded by mu; for shutdown-on-stop
   std::mutex mu;
   std::condition_variable cv;
   std::map<std::string, std::string> kv;
@@ -121,6 +122,13 @@ void handle_conn(Server* s, int fd) {
       break;
     }
   }
+  {
+    // Remove our fd from conn_fds before closing: stop() shutdowns every fd
+    // still listed, and a closed-and-recycled fd number must not be there.
+    std::lock_guard<std::mutex> g(s->mu);
+    auto it = std::find(s->conn_fds.begin(), s->conn_fds.end(), fd);
+    if (it != s->conn_fds.end()) s->conn_fds.erase(it);
+  }
   ::close(fd);
 }
 
@@ -153,6 +161,7 @@ void* tcp_store_server_start(int port) {
         ::close(cfd);
         break;
       }
+      s->conn_fds.push_back(cfd);
       s->conns.emplace_back(handle_conn, s, cfd);
     }
   });
@@ -169,21 +178,33 @@ int tcp_store_server_port(void* sp) {
 }
 
 void tcp_store_server_stop(void* sp) {
+  // Handler threads may be blocked in cv.wait (stopping flag + notify wakes
+  // them) or in read() (shutdown on their fd wakes them with EOF). Join —
+  // never detach — every thread before freeing the Server, otherwise a
+  // mid-process stop races threads still touching s->mu/s->kv.
   auto* s = static_cast<Server*>(sp);
   {
     std::lock_guard<std::mutex> g(s->mu);
     s->stopping = true;
+    for (int cfd : s->conn_fds) ::shutdown(cfd, SHUT_RDWR);
   }
   s->cv.notify_all();
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  // accept_thread has exited, so no more threads are appended to conns.
   for (auto& t : s->conns)
-    if (t.joinable()) t.detach();  // blocked conns die with process
+    if (t.joinable()) t.join();
   delete s;
 }
 
-intptr_t tcp_store_connect(const char* host, int port, int timeout_ms) {
+// timeout_ms bounds connect(); io_timeout_ms bounds each blocking
+// GET/WAIT/response read (rendezvous waits legitimately run minutes, so this
+// is a separate, much longer bound). A timed-out request leaves the
+// length-prefixed stream desynchronized — callers must treat failure as
+// fatal for the connection, not retry on the same fd.
+intptr_t tcp_store_connect(const char* host, int port, int timeout_ms,
+                           int io_timeout_ms) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   sockaddr_in addr{};
@@ -204,6 +225,16 @@ intptr_t tcp_store_connect(const char* host, int port, int timeout_ms) {
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Bound GET/WAIT on the connected socket too (the protocol contract
+  // above): a key that is never set must raise on the client instead of
+  // hanging the rank forever.
+  if (io_timeout_ms > 0) {
+    timeval tv{};
+    tv.tv_sec = io_timeout_ms / 1000;
+    tv.tv_usec = (io_timeout_ms % 1000) * 1000;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
   return fd;
 }
 
@@ -235,14 +266,17 @@ long tcp_store_get(intptr_t fd, const char* key, void* buf, long cap) {
   return n;
 }
 
-long long tcp_store_add(intptr_t fd, const char* key, long long delta) {
-  std::string out;
-  if (request(static_cast<int>(fd), 3, key, &delta, 8, &out) != 0 ||
-      out.size() < 8)
+// errno-style: returns 0 and writes the new counter into *out, or -1 on
+// failure (a plain long long return could not distinguish a legitimate
+// counter value of -1 from an error).
+int tcp_store_add(intptr_t fd, const char* key, long long delta,
+                  long long* out) {
+  std::string resp;
+  if (request(static_cast<int>(fd), 3, key, &delta, 8, &resp) != 0 ||
+      resp.size() < 8)
     return -1;
-  long long v;
-  memcpy(&v, out.data(), 8);
-  return v;
+  memcpy(out, resp.data(), 8);
+  return 0;
 }
 
 int tcp_store_wait(intptr_t fd, const char* key) {

@@ -20,7 +20,11 @@ __all__ = ["TCPStore", "create_or_get_global_tcp_store"]
 
 class TCPStore:
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 world_size=1, timeout=30):
+                 world_size=1, timeout=30, io_timeout=900):
+        """`timeout` bounds connect(); `io_timeout` bounds each blocking
+        GET/WAIT (rendezvous waits legitimately run minutes while stragglers
+        start up — reference default is 900s, tcp_store.h:121). A timed-out
+        request desynchronizes the connection; treat it as fatal."""
         lib = native.load()
         if lib is None:
             raise RuntimeError(
@@ -37,7 +41,8 @@ class TCPStore:
             port = lib.tcp_store_server_port(self._server)
         self._port = int(port)
         self._fd = lib.tcp_store_connect(host.encode(), self._port,
-                                         self._timeout_ms)
+                                         self._timeout_ms,
+                                         int(io_timeout * 1000))
         if self._fd < 0:
             if self._server:
                 lib.tcp_store_server_stop(self._server)
@@ -59,19 +64,30 @@ class TCPStore:
     def get(self, key: str) -> bytes:
         import ctypes
 
-        buf = ctypes.create_string_buffer(1 << 20)
+        cap = 1 << 20
         with self._lock:
-            n = self._lib.tcp_store_get(self._fd, key.encode(), buf, len(buf))
+            for _ in range(8):  # value may grow between round-trips
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.tcp_store_get(self._fd, key.encode(), buf, cap)
+                if n <= cap:
+                    break
+                cap = int(n)
+            else:
+                raise RuntimeError("TCPStore.get: value kept outgrowing buffer")
         if n < 0:
             raise RuntimeError("TCPStore.get failed")
         return buf.raw[:n]
 
     def add(self, key: str, amount: int) -> int:
+        import ctypes
+
+        out = ctypes.c_longlong(0)
         with self._lock:
-            v = self._lib.tcp_store_add(self._fd, key.encode(), int(amount))
-        if v == -1:
+            rc = self._lib.tcp_store_add(self._fd, key.encode(), int(amount),
+                                         ctypes.byref(out))
+        if rc != 0:
             raise RuntimeError("TCPStore.add failed")
-        return int(v)
+        return int(out.value)
 
     def wait(self, keys):
         if isinstance(keys, str):

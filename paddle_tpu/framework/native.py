@@ -33,20 +33,55 @@ def _build():
         return False
 
 
+def _stale():
+    """True if any native source is newer than the built .so."""
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    for f in os.listdir(_NATIVE_DIR):
+        if f.endswith((".cc", ".h")) or f == "Makefile":
+            if os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > so_mtime:
+                return True
+    return False
+
+
 def load():
-    """Return the ctypes lib, building it if needed; None if unavailable."""
+    """Return the ctypes lib, (re)building when sources changed; None if unavailable."""
     global _lib
     if _lib is not None:
         return _lib
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO) and not _build():
-            return None
+        # Rebuild whenever a source file is newer than the .so — a prebuilt
+        # library must never mask edits to native/*.cc. An exclusive file
+        # lock serializes concurrent ranks on one host (all ranks' first
+        # load() would otherwise race `make` against a sibling's dlopen);
+        # held through CDLL so no sibling truncates the .so mid-map. If no
+        # toolchain is available, fall back to an existing (possibly stale)
+        # build.
+        import fcntl
+
+        lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
         try:
-            lib = ctypes.CDLL(_SO)
+            lock_fd = open(lock_path, "w")
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
         except OSError:
-            return None
+            lock_fd = None
+        try:
+            if _stale() and not _build() and not os.path.exists(_SO):
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                return None
+        finally:
+            if lock_fd is not None:
+                try:
+                    fcntl.flock(lock_fd, fcntl.LOCK_UN)
+                except OSError:
+                    pass
+                lock_fd.close()
         # tcp store
         lib.tcp_store_server_start.restype = ctypes.c_void_p
         lib.tcp_store_server_start.argtypes = [ctypes.c_int]
@@ -54,16 +89,18 @@ def load():
         lib.tcp_store_server_port.argtypes = [ctypes.c_void_p]
         lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
         lib.tcp_store_connect.restype = ctypes.c_ssize_t
-        lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_int]
         lib.tcp_store_set.restype = ctypes.c_int
         lib.tcp_store_set.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
                                       ctypes.c_char_p, ctypes.c_long]
         lib.tcp_store_get.restype = ctypes.c_long
         lib.tcp_store_get.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
                                       ctypes.c_char_p, ctypes.c_long]
-        lib.tcp_store_add.restype = ctypes.c_longlong
+        lib.tcp_store_add.restype = ctypes.c_int
         lib.tcp_store_add.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
-                                      ctypes.c_longlong]
+                                      ctypes.c_longlong,
+                                      ctypes.POINTER(ctypes.c_longlong)]
         lib.tcp_store_wait.restype = ctypes.c_int
         lib.tcp_store_wait.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p]
         lib.tcp_store_delete.restype = ctypes.c_int

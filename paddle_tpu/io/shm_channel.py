@@ -13,12 +13,18 @@ order without index headers.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import os
 import pickle
+import uuid
 
 from ..framework import native
 
 __all__ = ["ShmRing"]
+
+# Monotonic per-process sequence for ring names. id(object()) was reused
+# across consecutive calls, colliding all workers onto one segment.
+_ring_seq = itertools.count()
 
 
 class ShmRing:
@@ -34,7 +40,9 @@ class ShmRing:
         if lib is None:
             raise RuntimeError("native runtime unavailable — shm ring needs "
                                "native/libpaddle_tpu_native.so")
-        name = name or f"/pdtpu_ring_{os.getpid()}_{id(object()) & 0xFFFFFF:x}"
+        name = name or (
+            f"/pdtpu_ring_{os.getpid()}_{next(_ring_seq)}_{uuid.uuid4().hex[:8]}"
+        )
         h = lib.shm_ring_create(name.encode(), int(capacity))
         if not h:
             raise RuntimeError(f"shm_ring_create({name}) failed")
