@@ -45,6 +45,8 @@ __all__ = [
     "in_tracing",
     "tracing_guard",
     "register_tensor_method",
+    "dispatch_cache_stats",
+    "clear_dispatch_cache",
 ]
 
 # --------------------------------------------------------------------------- #
@@ -584,6 +586,149 @@ def _normalize_index(idx):
 # op execution
 # --------------------------------------------------------------------------- #
 
+# Eager dispatch cache: (op name, fn code, value-hashed closure/defaults,
+# input avals, grad?) -> jitted executables. The reference makes the per-op
+# path microsecond-scale with generated C++ ad_func entry points
+# (eager_gen.py); here the same role is played by caching one compiled XLA
+# program (and one compiled VJP pair) per op signature, so eager mode stops
+# re-tracing fn / jax.vjp on every call. Keys hash the *values* of fn's
+# closure cells and defaults, so attr changes (axis=0 vs axis=1) key
+# separately; ops whose closures hold unhashable objects (arrays, rich
+# objects) or whose bodies cannot be jitted (value-dependent output shapes)
+# fall back to the uncached path permanently (per code object).
+
+from collections import OrderedDict as _OrderedDict
+
+
+class _NoKey(Exception):
+    pass
+
+
+def _token(v, depth=0):
+    """Hashable token reflecting the VALUE of a closure cell / default."""
+    if depth > 4:
+        raise _NoKey
+    if v is None:
+        return v
+    if isinstance(v, (int, float, bool, complex)):
+        # type-tagged: 1 == 1.0 == True hash-equal, but an int constant baked
+        # into a trace produces different output dtype than a float
+        return (type(v).__name__, v)
+    if isinstance(v, (str, bytes)):
+        return v
+    if isinstance(v, slice):
+        return ("sl", _token(v.start, depth + 1), _token(v.stop, depth + 1),
+                _token(v.step, depth + 1))
+    if isinstance(v, np.dtype):
+        return ("dt", v.str)
+    if isinstance(v, type):
+        return ("ty", v.__module__, v.__qualname__)
+    if isinstance(v, (tuple, list)):
+        return ("sq", isinstance(v, tuple),
+                tuple(_token(x, depth + 1) for x in v))
+    if isinstance(v, dict):
+        return ("dc", tuple(sorted(
+            ((repr(k), _token(x, depth + 1)) for k, x in v.items()))))
+    if callable(v) and hasattr(v, "__code__"):
+        return _fn_token(v, depth + 1)
+    raise _NoKey
+
+
+def _fn_token(fn, depth=0):
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # ufuncs / builtins: module-level singletons, key by the object
+        try:
+            hash(fn)
+        except TypeError:
+            raise _NoKey
+        return ("obj", fn)
+    try:
+        cells = fn.__closure__ or ()
+        return ("fn", code,
+                tuple(_token(c.cell_contents, depth + 1) for c in cells),
+                tuple(_token(d, depth + 1) for d in (fn.__defaults__ or ())))
+    except (_NoKey, ValueError):  # ValueError: empty cell
+        raise _NoKey
+
+
+_DISPATCH_CACHE: "_OrderedDict[tuple, tuple]" = _OrderedDict()
+_DISPATCH_CAP = 8192
+_UNCACHEABLE: set = set()  # (name, code) pairs that failed to jit
+_dispatch_stats = {"hits": 0, "misses": 0, "bypass": 0}
+_dispatch_lock = threading.Lock()
+
+
+def dispatch_cache_stats():
+    return dict(_dispatch_stats)
+
+
+def clear_dispatch_cache():
+    with _dispatch_lock:
+        _DISPATCH_CACHE.clear()
+        _UNCACHEABLE.clear()
+        _dispatch_stats.update(hits=0, misses=0, bypass=0)
+
+
+def _dispatch_key(name, fn, values, need_grad):
+    try:
+        if (name, getattr(fn, "__code__", fn)) in _UNCACHEABLE:
+            return None
+        # weak_type matters: jax.jit retraces on weak-vs-strong scalars, and
+        # two traces under one entry would desynchronize the bwd treedef
+        avals = tuple(
+            (v.shape, str(v.dtype), bool(getattr(v, "weak_type", False)))
+            for v in values
+        )
+        return (name, _fn_token(fn), avals, need_grad)
+    except (_NoKey, TypeError, AttributeError):
+        return None
+
+
+def _cache_get(key):
+    with _dispatch_lock:
+        entry = _DISPATCH_CACHE.get(key)
+        if entry is not None:
+            _DISPATCH_CACHE.move_to_end(key)
+            _dispatch_stats["hits"] += 1
+        return entry
+
+
+def _cache_put(key, entry):
+    with _dispatch_lock:
+        _dispatch_stats["misses"] += 1
+        _DISPATCH_CACHE[key] = entry
+        if len(_DISPATCH_CACHE) > _DISPATCH_CAP:
+            _DISPATCH_CACHE.popitem(last=False)
+
+
+def _make_grad_pair(fn):
+    """Jitted (fwd, bwd): fwd returns (out, flat residuals); bwd reapplies.
+
+    jax.vjp's returned Partial is a pytree whose leaves are the residual
+    arrays; its treedef (the staged backward computation) is static per
+    input-aval signature, which is exactly our cache granularity — so the
+    treedef captured at fwd trace time is the right one for every bwd call
+    through this entry.
+    """
+    store = {}
+
+    def fwd_raw(*xs):
+        out, vjp_fn = jax.vjp(fn, *xs)
+        res, tree = jax.tree_util.tree_flatten(vjp_fn)
+        # first trace wins; the outer cache key (avals incl. weak_type) gives
+        # one trace per entry, and _finish_op guards the leaf count so a
+        # pathological retrace degrades to an error, never silent corruption
+        store.setdefault("tree", tree)
+        store.setdefault("n_res", len(res))
+        return out, res
+
+    def bwd_raw(res, cts):
+        vjp_fn = jax.tree_util.tree_unflatten(store["tree"], res)
+        return vjp_fn(cts)
+
+    return jax.jit(fwd_raw), jax.jit(bwd_raw), store
+
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """paddle.to_tensor (reference: python/paddle/tensor/creation.py to_tensor)."""
@@ -643,13 +788,59 @@ def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = No
         and any(not t.stop_gradient or t._grad_node is not None for t in tensors)
     )
 
+    # Dispatch cache lookup — bypassed inside traces (the functional/jit path
+    # must stay a plain trace) and for tracer inputs.
+    key = None
+    if not in_tracing() and not any(isinstance(v, jax.core.Tracer) for v in values):
+        key = _dispatch_key(name, fn, values, need_grad)
+    failed_pair = None
+    if key is not None:
+        entry = _cache_get(key)
+        if entry is None:
+            try:
+                if need_grad:
+                    fwd, bwd, store = _make_grad_pair(fn)
+                    out, res = fwd(*values)  # trace + compile now
+                    entry = ("grad", fwd, bwd, store, fn)
+                else:
+                    jfn = jax.jit(fn)
+                    out = jfn(*values)
+                    entry = ("nograd", jfn, fn)
+                _cache_put(key, entry)
+            except Exception:
+                # fn may not be jittable (e.g. value-dependent output shape)
+                # — or the call itself may be bad (shape mismatch). Fall
+                # through to the eager path; blacklist only if eager succeeds.
+                failed_pair = (name, getattr(fn, "__code__", fn))
+                entry = None
+            if entry is not None:
+                return _finish_op(name, out, res if need_grad else None,
+                                  entry, tensors, need_grad)
+        else:
+            if need_grad:
+                out, res = entry[1](*values)
+                return _finish_op(name, out, res, entry, tensors, True)
+            out = entry[1](*values)
+            return _finish_op(name, out, None, entry, tensors, False)
+    else:
+        _dispatch_stats["bypass"] += 1
+
     if not need_grad:
         out = fn(*values)
+        if failed_pair is not None:
+            _UNCACHEABLE.add(failed_pair)
         if isinstance(out, tuple):
             return tuple(Tensor(o) for o in out)
         return Tensor(out)
 
     out, vjp_fn = jax.vjp(fn, *values)
+    if failed_pair is not None:
+        _UNCACHEABLE.add(failed_pair)
+    return _wrap_grad_outputs(name, out, vjp_fn, tensors)
+
+
+def _wrap_grad_outputs(name, out, vjp_fn, tensors):
+    """Tape wiring shared by the cached and uncached grad paths."""
     multi = isinstance(out, tuple)
     outs = out if multi else (out,)
     avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
@@ -662,3 +853,18 @@ def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = No
         result.append(t)
     node.set_outputs(result)
     return tuple(result) if multi else result[0]
+
+
+def _finish_op(name, out, res, entry, tensors, need_grad):
+    """Wrap cached-dispatch outputs into Tensors (+ tape node when needed)."""
+    if not need_grad:
+        if isinstance(out, tuple):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+    bwd, store = entry[2], entry[3]
+    if len(res) != store.get("n_res", len(res)):
+        raise RuntimeError(
+            f"dispatch cache: op '{name}' retraced with a different residual "
+            "structure; clear_dispatch_cache() and report this op")
+    vjp_fn = lambda cts: bwd(res, cts)  # noqa: E731
+    return _wrap_grad_outputs(name, out, vjp_fn, tensors)

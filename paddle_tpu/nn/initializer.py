@@ -47,6 +47,34 @@ def calculate_gain(nonlinearity, param=None):
     return gains[nonlinearity]
 
 
+# One jitted executable per (shape, dtype) — init of a large model is
+# thousands of tiny ops, and each eager op over the TPU tunnel pays a
+# compile+RPC round trip; sampling+affine+cast fused into a single cached
+# program makes it one.
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("shape", "dtype"))
+def _sample_normal(key, mean, std, shape, dtype):
+    return (mean + std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+@_partial(jax.jit, static_argnames=("shape", "dtype"))
+def _sample_truncated(key, mean, std, a, b, shape, dtype):
+    v = jax.random.truncated_normal(key, a, b, shape, jnp.float32)
+    return (mean + std * v).astype(dtype)
+
+
+@_partial(jax.jit, static_argnames=("shape", "dtype"))
+def _sample_uniform(key, low, high, shape, dtype):
+    return jax.random.uniform(key, shape, jnp.float32, low, high).astype(dtype)
+
+
+@_partial(jax.jit, static_argnames=("shape", "dtype"))
+def _full_value(value, shape, dtype):
+    return jnp.full(shape, value, dtype)
+
+
 def _fans(shape):
     shape = tuple(shape)
     if len(shape) == 0:
@@ -74,7 +102,8 @@ class Constant(Initializer):
         self.value = value
 
     def __call__(self, param, block=None):
-        return self._set(param, jnp.full(tuple(param.shape), self.value, jnp.float32))
+        return self._set(
+            param, _full_value(self.value, tuple(param.shape), param.dtype))
 
 
 class Normal(Initializer):
@@ -82,9 +111,8 @@ class Normal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, param, block=None):
-        v = self.mean + self.std * jax.random.normal(
-            rnd.next_key(), tuple(param.shape), jnp.float32
-        )
+        v = _sample_normal(rnd.next_key(), self.mean, self.std,
+                           tuple(param.shape), param.dtype)
         return self._set(param, v)
 
 
@@ -93,9 +121,8 @@ class TruncatedNormal(Initializer):
         self.mean, self.std, self.a, self.b = mean, std, a, b
 
     def __call__(self, param, block=None):
-        v = self.mean + self.std * jax.random.truncated_normal(
-            rnd.next_key(), self.a, self.b, tuple(param.shape), jnp.float32
-        )
+        v = _sample_truncated(rnd.next_key(), self.mean, self.std, self.a,
+                              self.b, tuple(param.shape), param.dtype)
         return self._set(param, v)
 
 
@@ -104,9 +131,8 @@ class Uniform(Initializer):
         self.low, self.high = low, high
 
     def __call__(self, param, block=None):
-        v = jax.random.uniform(
-            rnd.next_key(), tuple(param.shape), jnp.float32, self.low, self.high
-        )
+        v = _sample_uniform(rnd.next_key(), self.low, self.high,
+                            tuple(param.shape), param.dtype)
         return self._set(param, v)
 
 
@@ -119,7 +145,8 @@ class XavierNormal(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         std = self.gain * math.sqrt(2.0 / (fi + fo))
-        v = std * jax.random.normal(rnd.next_key(), tuple(param.shape), jnp.float32)
+        v = _sample_normal(rnd.next_key(), 0.0, std, tuple(param.shape),
+                           param.dtype)
         return self._set(param, v)
 
 
@@ -132,9 +159,8 @@ class XavierUniform(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         limit = self.gain * math.sqrt(6.0 / (fi + fo))
-        v = jax.random.uniform(
-            rnd.next_key(), tuple(param.shape), jnp.float32, -limit, limit
-        )
+        v = _sample_uniform(rnd.next_key(), -limit, limit, tuple(param.shape),
+                            param.dtype)
         return self._set(param, v)
 
 
@@ -149,7 +175,8 @@ class KaimingNormal(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
         std = gain / math.sqrt(fi)
-        v = std * jax.random.normal(rnd.next_key(), tuple(param.shape), jnp.float32)
+        v = _sample_normal(rnd.next_key(), 0.0, std, tuple(param.shape),
+                           param.dtype)
         return self._set(param, v)
 
 
@@ -164,9 +191,8 @@ class KaimingUniform(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
         limit = gain * math.sqrt(3.0 / fi)
-        v = jax.random.uniform(
-            rnd.next_key(), tuple(param.shape), jnp.float32, -limit, limit
-        )
+        v = _sample_uniform(rnd.next_key(), -limit, limit, tuple(param.shape),
+                            param.dtype)
         return self._set(param, v)
 
 

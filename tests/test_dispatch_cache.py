@@ -1,0 +1,156 @@
+"""Eager dispatch cache (framework/core.py run_op).
+
+Reference bar: everything above the kernel must be microsecond-scale per op
+(SURVEY §3.1 hot-loop note; the reference generates C++ ad_func entry points,
+eager_gen.py). Here the analog is one cached compiled program per
+(op, attrs, avals, grad) signature — these tests assert reuse, attr-change
+separation, fallback for unjittable ops, and numeric parity with the
+uncached path.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import (
+    clear_dispatch_cache,
+    dispatch_cache_stats,
+    run_op,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_dispatch_cache()
+    yield
+    clear_dispatch_cache()
+
+
+def test_repeat_op_hits_cache():
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    y = paddle.to_tensor(np.ones((8, 8), np.float32))
+    _ = paddle.add(x, y)
+    base = dispatch_cache_stats()
+    for _ in range(5):
+        _ = paddle.add(x, y)
+    s = dispatch_cache_stats()
+    assert s["hits"] >= base["hits"] + 5
+    assert s["misses"] == base["misses"]
+
+
+def test_attr_change_keys_separately():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    a0 = paddle.sum(x, axis=0)
+    a1 = paddle.sum(x, axis=1)
+    assert a0.shape == [4] and a1.shape == [3]
+    np.testing.assert_allclose(a0.numpy(), x.numpy().sum(0))
+    np.testing.assert_allclose(a1.numpy(), x.numpy().sum(1))
+    # repeat both: each should hit its own entry
+    h0 = dispatch_cache_stats()["hits"]
+    _ = paddle.sum(x, axis=0)
+    _ = paddle.sum(x, axis=1)
+    assert dispatch_cache_stats()["hits"] >= h0 + 2
+
+
+def test_shape_change_keys_separately():
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    b = paddle.to_tensor(np.ones((2, 2), np.float32))
+    _ = paddle.exp(a)
+    m = dispatch_cache_stats()["misses"]
+    _ = paddle.exp(b)  # different aval -> new entry
+    assert dispatch_cache_stats()["misses"] == m + 1
+
+
+def test_grad_path_cached_and_correct():
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 16)).astype(np.float32)
+    wv = rng.standard_normal((16, 16)).astype(np.float32)
+    x = paddle.to_tensor(xv)
+    w = paddle.to_tensor(wv, stop_gradient=False)
+
+    def step():
+        y = paddle.matmul(x, w)
+        loss = paddle.sum(y * y)
+        loss.backward()
+        g = np.array(w.grad.numpy())
+        w.clear_grad()
+        return g
+
+    g1 = step()
+    hits_before = dispatch_cache_stats()["hits"]
+    g2 = step()
+    assert dispatch_cache_stats()["hits"] > hits_before
+    np.testing.assert_allclose(g1, g2, rtol=1e-5)
+    # numpy oracle: d/dw sum((xw)^2) = 2 x^T (x w)
+    oracle = 2.0 * xv.T @ (xv @ wv)
+    np.testing.assert_allclose(g1, oracle, rtol=1e-3, atol=1e-3)
+
+
+def test_unjittable_op_falls_back():
+    x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
+
+    def host_round_trip(a):
+        # np.asarray on a tracer raises -> not jittable, must fall back
+        return paddle.framework.core.jnp.asarray(np.asarray(a) * 2.0)
+
+    out = run_op("host_round_trip", host_round_trip, [x])
+    np.testing.assert_allclose(out.numpy(), [2.0, -4.0, 6.0])
+    # second call: bypassed (blacklisted), still correct
+    b = dispatch_cache_stats()["bypass"]
+    out2 = run_op("host_round_trip", host_round_trip, [x])
+    np.testing.assert_allclose(out2.numpy(), [2.0, -4.0, 6.0])
+    assert dispatch_cache_stats()["bypass"] > b
+
+
+def test_inplace_and_hooks_still_work():
+    x = paddle.to_tensor(np.zeros((4,), np.float32), stop_gradient=False)
+    seen = []
+    y = x * 2.0
+    y.register_hook(lambda g: seen.append(np.array(g.numpy())))
+    y.sum().backward()
+    assert seen and np.allclose(seen[0], 1.0)
+    assert np.allclose(np.array(x.grad.numpy()), 2.0)
+
+
+def test_weak_vs_strong_scalar_keys_separately():
+    # jax.jit retraces on weak_type; one shared cache entry would apply the
+    # bwd treedef of one trace to the residuals of the other (silent wrong
+    # grads) — so weak and strong scalars must key separately.
+    import jax.numpy as jnp
+
+    x = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    weak = paddle.framework.core.Tensor(jnp.asarray(2.0))      # weak f32
+    strong = paddle.framework.core.Tensor(jnp.float32(2.0))    # strong f32
+
+    def go(s):
+        y = x * s
+        y.sum().backward()
+        g = np.array(x.grad.numpy())
+        x.clear_grad()
+        return g
+
+    g_w = go(weak)
+    m = dispatch_cache_stats()["misses"]
+    g_s = go(strong)
+    assert dispatch_cache_stats()["misses"] == m + 1  # distinct entry
+    np.testing.assert_allclose(g_w, g_s)
+    np.testing.assert_allclose(g_w, 2.0)
+
+
+def test_int_vs_float_attr_keys_separately():
+    x = paddle.to_tensor(np.ones((4,), np.int32))
+    two_i = 2
+    two_f = 2.0
+    a = run_op("scale_attr", lambda v, s=two_i: v * s, [x])
+    b = run_op("scale_attr", lambda v, s=two_f: v * s, [x])
+    assert a.dtype == np.int32
+    np.testing.assert_allclose(a.numpy(), 2)
+    np.testing.assert_allclose(b.numpy(), 2.0)
+
+
+def test_multi_output_op_cached():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    for _ in range(2):
+        top, idx = paddle.topk(x, k=3)
+        np.testing.assert_allclose(top.numpy(), [5.0, 4.0, 3.0])
+        np.testing.assert_allclose(idx.numpy(), [5, 4, 3])
