@@ -26,6 +26,14 @@ import jax.numpy as jnp
 
 from ....framework.core import Tensor, run_op, to_tensor
 
+
+def _pallas_decode_on():
+    # Route decode attention to the Pallas kernels on TPU (or under the
+    # interpreter); jnp composites elsewhere.
+    from ....nn.functional.flash_attention import _use_pallas_kernel
+
+    return _use_pallas_kernel()
+
 __all__ = [
     "swiglu",
     "fused_rotary_position_embedding",
@@ -511,6 +519,13 @@ def masked_multihead_attention(
         k_cache = jax.vmap(write)(cache[0], k_new, lens)
         v_cache = jax.vmap(write)(cache[1], v_new, lens)
 
+        if mask is None and _pallas_decode_on():
+            from ....ops.pallas.decode_attention import dense_decode_attention
+
+            out = dense_decode_attention(q, k_cache, v_cache, lens + 1)
+            new_cache = jnp.stack([k_cache, v_cache], 0)
+            return out.reshape(B, H * D).astype(xv.dtype), new_cache
+
         keep = (jnp.arange(S_max)[None, :] <= lens[:, None])[:, None, None, :]
         add = mask.reshape(B, 1, 1, -1)[..., :S_max] if mask is not None else None
         out = masked_attention(
@@ -605,6 +620,15 @@ def block_multihead_attention(
         kc = kc.at[flat_pages, :, flat_slot].set(kn.astype(kc.dtype), mode="drop")
         vc = vc.at[flat_pages, :, flat_slot].set(vn.astype(vc.dtype), mode="drop")
 
+        total = offs + jnp.where(enc_lens > 0, enc_lens, 1)
+        if S == 1 and _pallas_decode_on():
+            # hot decode loop: paged Pallas kernel — block table resolved in
+            # the BlockSpec index_map, no gathered cache copy materialized
+            from ....ops.pallas.decode_attention import paged_decode_attention
+
+            out = paged_decode_attention(q[:, 0], kc, vc, tables, total)
+            return (out.reshape(B, S, H * D).astype(qkv_v.dtype), qkv_v, kc, vc)
+
         # ---- gather pages & attend ----
         max_pages = tables.shape[1]
         S_max = max_pages * bs
@@ -616,7 +640,6 @@ def block_multihead_attention(
         qpos = pos                                              # [B, S]
         kpos = jnp.arange(S_max)[None, :]
         keep = kpos[:, None, :] <= qpos[..., None]              # [B, S, S_max]
-        total = offs + jnp.where(enc_lens > 0, enc_lens, 1)
         keep = keep & (kpos[:, None, :] < total[:, None, None])
         out = masked_attention(q, gk, gv, keep=keep[:, None])
         return (out.reshape(B, S, H * D).astype(qkv_v.dtype), qkv_v, kc, vc)

@@ -155,9 +155,20 @@ def flash_attn_unpadded(
 ):
     """Varlen attention over packed sequences (reference: flash_attn_unpadded).
     q/k/v: [total_tokens, H, D]; cu_seqlens: [B+1] prefix sums. Implemented by
-    building a block-diagonal mask over the packed layout — segment-ids style,
-    the TPU-idiomatic way to handle ragged batches without dynamic shapes."""
+    segment ids over the packed layout — the TPU-idiomatic ragged encoding;
+    on TPU it runs the Pallas varlen kernel (block-sparse: tiles whose q/k
+    segments cannot intersect are skipped), elsewhere a dense jnp fallback."""
     ins = [_t(query), _t(key), _t(value), _t(cu_seqlens_q), _t(cu_seqlens_k)]
+
+    if _use_pallas_kernel() and dropout == 0.0:
+        from ...ops.pallas.masked_flash import varlen_flash_attention_fwd
+
+        def fnp(q, k, v, cq, ck):
+            return varlen_flash_attention_fwd(q, k, v, cq, ck, scale,
+                                              causal=causal)
+
+        out = run_op("flash_attn_unpadded", fnp, ins)
+        return out, None
 
     def fn(q, k, v, cq, ck):
         Tq, H, D = q.shape
@@ -210,6 +221,18 @@ def flashmask_attention(
     has_idx = startend_row_indices is not None
     if has_idx:
         ins.append(_t(startend_row_indices))
+
+    if (_use_pallas_kernel() and has_idx and dropout == 0.0
+            and window_size is None and not return_softmax_lse):
+        from ...ops.pallas.masked_flash import flashmask_attention_fwd
+
+        def fnp(q, k, v, idx):
+            return flashmask_attention_fwd(q, k, v, idx, causal=causal)
+
+        out = run_op("flashmask_attention", fnp, ins)
+        if return_seed_offset:
+            return (out, *([None] * int(return_seed_offset)))
+        return out
 
     def fn(q, k, v, *rest):
         B, Sq, H, D = q.shape

@@ -1,0 +1,173 @@
+"""Paged-KV and dense-cache decode attention for TPU, in Pallas.
+
+Reference analogs: block_multihead_attention's paged decode path
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu,
+block_attn.h) and masked_multihead_attention
+(fusion/gpu/masked_multihead_attention_kernel.cu, mmha_util.cu.h).
+
+TPU-native design: decode is HBM-bound — the entire job is streaming the KV
+cache through VMEM exactly once per step. The paged variant prefetches the
+block table as a scalar operand (pltpu.PrefetchScalarGridSpec) so the
+per-page physical index is resolved in the BlockSpec index_map: the pipeline
+DMAs each logical page straight from its physical slot, no gathered copy of
+the cache is ever materialized (the jnp composite's `kc[tables]` gather is
+exactly what XLA does badly — SURVEY §7 hard parts). Pages past a row's
+length are skipped (no DMA cost model change, but no MXU/VPU work), and the
+final page is masked per-slot. GQA: grid is (batch, kv_head, page) and each
+step attends the head-group [g, D] block against one [page, D] page.
+
+Single-token decode (q = one step per row), inference only (no VJP).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import interpret_mode
+from .flash_attention import NEG_INF
+
+__all__ = ["paged_decode_attention", "dense_decode_attention"]
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, ps, np_, g, paged):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+    base = p * ps
+    valid_page = base < length
+    if paged:
+        valid_page = valid_page & (tables_ref[b, p] >= 0)
+
+    # scratch rows are padded to >=8 for TPU tiling; compute on the first g
+    @pl.when(valid_page)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)      # [g, D]
+        k = k_ref[0, 0].astype(jnp.float32)      # [ps, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                # [g, ps]
+        slot = base + jax.lax.broadcasted_iota(jnp.int32, (g, ps), 1)
+        s = jnp.where(slot < length, s, NEG_INF)
+
+        m_prev = m_scr[0:g, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new)
+        pr = jnp.where(slot < length, pr, 0.0)
+        l_scr[0:g, :] = jnp.broadcast_to(
+            alpha * l_scr[0:g, 0:1] + jnp.sum(pr, axis=-1, keepdims=True),
+            (g, l_scr.shape[1]))
+        v = v_ref[0, 0].astype(jnp.float32)      # [ps, D]
+        pv = jax.lax.dot_general(
+            pr, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_scr[0:g, :] = acc_scr[0:g, :] * alpha + pv
+        m_scr[0:g, :] = jnp.broadcast_to(m_new, (g, m_scr.shape[1]))
+
+    @pl.when(p == np_ - 1)
+    def _finish():
+        l = l_scr[0:g, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[0:g, :] / l_safe).astype(o_ref.dtype)
+
+
+def _run_decode(q, kc, vc, tables, lengths, scale, paged):
+    """q: [B, Hkv, g, D]; kc/vc paged [n_pages, Hkv, ps, D] or dense
+    [B, Hkv, S_max, D] (viewed as ps-sized pages). tables: [B, P] (paged) or
+    a dummy [B, 1] (dense)."""
+    B, Hkv, g, D = q.shape
+    if paged:
+        _, _, ps, _ = kc.shape
+        P = tables.shape[1]
+
+        def kmap(b, h, p, tabs, lens):
+            t = tabs[b, p]
+            return (jnp.where(t < 0, 0, t), h, 0, 0)
+    else:
+        S_max = kc.shape[2]
+        ps = min(256, S_max)
+        while S_max % ps:
+            ps //= 2
+        P = S_max // ps
+
+        def kmap(b, h, p, tabs, lens):
+            return (b, h, p, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, ps=ps, np_=P, g=g, paged=paged)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, D), lambda b, h, p, tabs, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, ps, D), kmap),
+            pl.BlockSpec((1, 1, ps, D), kmap),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, D),
+                               lambda b, h, p, tabs, lens: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((max(g, 8), 128), jnp.float32),
+            pltpu.VMEM((max(g, 8), 128), jnp.float32),
+            pltpu.VMEM((max(g, 8), D), jnp.float32),
+        ],
+    )
+    # paged: cache already [n_pages, Hkv, ps, D]; dense: the index_map views
+    # the [B, Hkv, S_max, D] cache as ps-sized blocks of the sequence axis
+    kshaped, vshaped = kc, vc
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, g, D), q.dtype),
+        interpret=interpret_mode(),
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, kshaped, vshaped)
+    return out
+
+
+def _split_heads(q, Hkv):
+    B, H, D = q.shape
+    g = H // Hkv
+    return q.reshape(B, Hkv, g, D), g
+
+
+def paged_decode_attention(q, key_cache, value_cache, block_tables, lengths,
+                           scale=None):
+    """q: [B, H, D] (one decode step); key/value_cache:
+    [n_pages, Hkv, page_size, D]; block_tables: [B, P] physical page ids
+    (-1 unused); lengths: [B] valid tokens incl. the current one (caller has
+    already written the step's K/V into the cache). Returns [B, H, D]."""
+    B, H, D = q.shape
+    Hkv = key_cache.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    q4, g = _split_heads(q, Hkv)
+    out = _run_decode(q4, key_cache, value_cache, block_tables, lengths,
+                      scale, paged=True)
+    return out.reshape(B, H, D)
+
+
+def dense_decode_attention(q, key_cache, value_cache, lengths, scale=None):
+    """MMHA analog on a dense cache: q [B, H, D]; key/value_cache
+    [B, Hkv, S_max, D]; lengths [B] valid tokens incl. current. -> [B, H, D]."""
+    B, H, D = q.shape
+    Hkv = key_cache.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    q4, g = _split_heads(q, Hkv)
+    dummy_tables = jnp.zeros((B, 1), jnp.int32)
+    out = _run_decode(q4, key_cache, value_cache, dummy_tables, lengths,
+                      scale, paged=False)
+    return out.reshape(B, H, D)
