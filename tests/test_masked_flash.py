@@ -1,0 +1,300 @@
+"""Interpret-mode parity for the flashmask / varlen / decode Pallas kernels
+vs jnp oracles (reference OpTest pattern, test/legacy_test/op_test.py:418;
+kernel analogs: paddle/phi/kernels/gpu/flash_attn_kernel.cu:832 flashmask and
+varlen params, fusion/gpu/block_attn.h, masked_multihead_attention_kernel.cu).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.masked_flash import (
+    flashmask_attention_fwd,
+    varlen_flash_attention_fwd,
+)
+from paddle_tpu.ops.pallas.decode_attention import (
+    dense_decode_attention,
+    paged_decode_attention,
+)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+
+
+# --------------------------------------------------------------------------- #
+# flashmask
+# --------------------------------------------------------------------------- #
+
+
+def _flashmask_keep_ref(idx, Sq, Sk, causal):
+    """[B, Hm, Sq, Sk] keep-mask from startend_row_indices [B, Hm, Sk, n]."""
+    B, Hm, _, n = idx.shape
+    rows = np.arange(Sq)[:, None]  # query row
+    idx = np.moveaxis(np.asarray(idx), 2, 3)  # [B, Hm, n, Sk]
+    if causal:
+        start = idx[:, :, 0][:, :, None, :]
+        if n == 1:
+            masked = rows[None, None] >= start
+        else:
+            end = idx[:, :, 1][:, :, None, :]
+            masked = (rows[None, None] >= start) & (rows[None, None] < end)
+    else:
+        if n == 2:
+            lts = idx[:, :, 0][:, :, None, :]
+            ute = idx[:, :, 1][:, :, None, :]
+            masked = (rows[None, None] >= lts) | (rows[None, None] < ute)
+        else:
+            lts = idx[:, :, 0][:, :, None, :]
+            lte = idx[:, :, 1][:, :, None, :]
+            uts = idx[:, :, 2][:, :, None, :]
+            ute = idx[:, :, 3][:, :, None, :]
+            masked = ((rows[None, None] >= lts) & (rows[None, None] < lte)) | (
+                (rows[None, None] >= uts) & (rows[None, None] < ute)
+            )
+    keep = ~masked
+    if causal:
+        keep = keep & np.tril(np.ones((Sq, Sk), bool))[None, None]
+    return keep
+
+
+def _masked_ref(q, k, v, keep):
+    """q [B,S,H,D], keep [B,Hm,Sq,Sk] -> [B,S,H,D]; rows w/ no kept key -> 0."""
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    Hm = keep.shape[1]
+    if Hm != H:
+        keep = jnp.repeat(jnp.asarray(keep), H // Hm, axis=1)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / np.sqrt(D)
+    logits = jnp.where(keep, logits, -1e30)
+    p = jax.nn.softmax(logits, -1)
+    # fully-masked rows: softmax of all -1e30 is uniform garbage; zero them
+    any_keep = jnp.any(keep, axis=-1, keepdims=True)
+    p = jnp.where(any_keep, p, 0.0)
+    return jnp.einsum("bhst,bthd->bshd", p.astype(v.dtype), v).astype(q.dtype)
+
+
+def _causal_doc_mask_idx(rng, B, Hm, S, n):
+    """Document-mask style indices for the causal encodings; a distinct doc
+    boundary per (batch, mask-head) so the idx BlockSpec index_map is
+    actually exercised."""
+    idx = np.empty((B, Hm, S, n), np.int32)
+    cols = np.arange(S)
+    for b in range(B):
+        for hm in range(Hm):
+            # split S into 2 docs at a random boundary; attention per doc
+            cut = int(rng.integers(S // 4, 3 * S // 4))
+            # rows >= start masked: start = doc end boundary per column
+            start = np.where(cols < cut, cut, S)
+            idx[b, hm, :, 0] = start
+            if n == 2:
+                idx[b, hm, :, 1] = S  # mask [start, S)
+    return jnp.asarray(idx)
+
+
+FM_CASES = [
+    # B, S, H, Hkv, Hm, D, causal, n
+    (1, 128, 4, 4, 1, 64, True, 1),
+    (1, 256, 4, 2, 1, 64, True, 2),   # GQA
+    (2, 128, 4, 4, 4, 32, True, 2),   # per-head mask
+    (1, 128, 2, 2, 1, 64, False, 2),
+    (1, 100, 2, 2, 1, 32, False, 4),  # padding path
+]
+
+
+@pytest.mark.parametrize("B,S,H,Hkv,Hm,D,causal,n", FM_CASES)
+def test_flashmask_parity(B, S, H, Hkv, Hm, D, causal, n):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, D)), jnp.float32)
+    if causal:
+        idx = _causal_doc_mask_idx(rng, B, Hm, S, n)
+    else:
+        if n == 2:
+            lts = rng.integers(S // 2, S, (B, Hm, S, 1))
+            ute = rng.integers(0, S // 2, (B, Hm, S, 1))
+            idx = jnp.asarray(np.concatenate([lts, ute], -1).astype(np.int32))
+        else:
+            lts = rng.integers(0, S // 2, (B, Hm, S, 1))
+            lte = lts + rng.integers(0, S // 4, (B, Hm, S, 1))
+            uts = rng.integers(S // 2, S, (B, Hm, S, 1))
+            ute = uts + rng.integers(0, S // 4, (B, Hm, S, 1))
+            idx = jnp.asarray(
+                np.concatenate([lts, lte, uts, ute], -1).astype(np.int32))
+
+    keep = _flashmask_keep_ref(np.asarray(idx), S, S, causal)
+    out = flashmask_attention_fwd(q, k, v, idx, causal=causal)
+    ref = _masked_ref(q, k, v, keep)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
+
+    g = jnp.asarray(rng.standard_normal(out.shape), jnp.float32)
+    gq, gk, gv = jax.grad(
+        lambda a, b, c: (flashmask_attention_fwd(a, b, c, idx, causal=causal) * g).sum(),
+        (0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(
+        lambda a, b, c: (_masked_ref(a, b, c, keep) * g).sum(), (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(gq, rq, rtol=1e-3, atol=2e-4)
+    np.testing.assert_allclose(gk, rk, rtol=1e-3, atol=2e-4)
+    np.testing.assert_allclose(gv, rv, rtol=1e-3, atol=2e-4)
+
+
+def test_flashmask_functional_dispatch():
+    """nn.functional.flashmask_attention routes to the kernel under interpret
+    mode and matches its own jnp fallback path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.nn.functional.flash_attention import sdp_kernel
+
+    rng = np.random.default_rng(3)
+    S = 128
+    q = paddle.to_tensor(rng.standard_normal((1, S, 2, 32)).astype("float32"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.standard_normal((1, S, 2, 32)).astype("float32"))
+    v = paddle.to_tensor(rng.standard_normal((1, S, 2, 32)).astype("float32"))
+    idx = paddle.to_tensor(
+        np.full((1, 1, S, 1), S, np.int32))  # nothing extra masked
+    out = F.flashmask_attention(q, k, v, startend_row_indices=idx, causal=True)
+    with sdp_kernel(enable_flash=False):
+        ref = F.flashmask_attention(q, k, v, startend_row_indices=idx, causal=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=2e-5)
+    out.sum().backward()
+    assert np.isfinite(q.grad.numpy()).all()
+
+
+# --------------------------------------------------------------------------- #
+# varlen
+# --------------------------------------------------------------------------- #
+
+
+def _varlen_ref(q, k, v, cq, ck, scale, causal):
+    Tq, H, D = q.shape
+    Tk = k.shape[0]
+    Hkv = k.shape[1]
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=1)
+        v = jnp.repeat(v, H // Hkv, axis=1)
+    cq = np.asarray(cq)
+    ck = np.asarray(ck)
+    seg_q = np.cumsum(np.bincount(cq[1:-1], minlength=Tq))[:Tq]
+    seg_k = np.cumsum(np.bincount(ck[1:-1], minlength=Tk))[:Tk]
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        pos_q = np.arange(Tq) - cq[seg_q]
+        pos_k = np.arange(Tk) - ck[seg_k]
+        mask = mask & (pos_q[:, None] >= pos_k[None, :])
+    logits = jnp.einsum("qhd,khd->hqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(jnp.asarray(mask)[None], logits, -1e30)
+    p = jax.nn.softmax(logits, -1)
+    return jnp.einsum("hqk,khd->qhd", p.astype(v.dtype), v).astype(q.dtype)
+
+
+VL_CASES = [
+    # q seqlens, k seqlens, H, Hkv, D, causal
+    ([60, 68], None, 4, 4, 64, True),
+    ([33, 50, 45], None, 4, 2, 32, True),   # GQA, unaligned boundaries
+    ([100, 156], None, 2, 2, 64, False),
+    ([7, 9, 11], None, 2, 1, 32, True),     # tiny, single block
+    ([40, 60], [90, 30], 2, 2, 32, False),  # cross: q lens != k lens
+]
+
+
+@pytest.mark.parametrize("lens_q,lens_k,H,Hkv,D,causal", VL_CASES)
+def test_varlen_parity(lens_q, lens_k, H, Hkv, D, causal):
+    rng = np.random.default_rng(1)
+    lens_k = lens_k or lens_q
+    Tq, Tk = sum(lens_q), sum(lens_k)
+    cq = np.concatenate([[0], np.cumsum(lens_q)]).astype(np.int32)
+    ck = np.concatenate([[0], np.cumsum(lens_k)]).astype(np.int32)
+    q = jnp.asarray(rng.standard_normal((Tq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((Tk, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((Tk, Hkv, D)), jnp.float32)
+    scale = 1.0 / np.sqrt(D)
+    cq_j, ck_j = jnp.asarray(cq), jnp.asarray(ck)
+
+    out = varlen_flash_attention_fwd(q, k, v, cq_j, ck_j, scale, causal=causal)
+    ref = _varlen_ref(q, k, v, cq, ck, scale, causal)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
+
+    g = jnp.asarray(rng.standard_normal(out.shape), jnp.float32)
+    gq, gk, gv = jax.grad(
+        lambda a, b, c: (varlen_flash_attention_fwd(
+            a, b, c, cq_j, ck_j, scale, causal=causal) * g).sum(), (0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(
+        lambda a, b, c: (_varlen_ref(a, b, c, cq, ck, scale, causal) * g).sum(),
+        (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(gq, rq, rtol=1e-3, atol=2e-4)
+    np.testing.assert_allclose(gk, rk, rtol=1e-3, atol=2e-4)
+    np.testing.assert_allclose(gv, rv, rtol=1e-3, atol=2e-4)
+
+
+# --------------------------------------------------------------------------- #
+# decode (dense MMHA-analog and paged)
+# --------------------------------------------------------------------------- #
+
+
+def _decode_ref(q, kc, vc, lengths):
+    """q [B,H,D]; kc/vc [B,Hkv,S,D]; lengths [B] -> [B,H,D]."""
+    B, H, D = q.shape
+    Hkv, S = kc.shape[1], kc.shape[2]
+    if Hkv != H:
+        kc = jnp.repeat(kc, H // Hkv, axis=1)
+        vc = jnp.repeat(vc, H // Hkv, axis=1)
+    logits = jnp.einsum("bhd,bhsd->bhs", q, kc).astype(jnp.float32) / np.sqrt(D)
+    keep = jnp.arange(S)[None, None, :] < jnp.asarray(lengths)[:, None, None]
+    logits = jnp.where(keep, logits, -1e30)
+    p = jax.nn.softmax(logits, -1)
+    return jnp.einsum("bhs,bhsd->bhd", p.astype(vc.dtype), vc).astype(q.dtype)
+
+
+@pytest.mark.parametrize("B,H,Hkv,D,S", [
+    (2, 4, 4, 64, 256),
+    (3, 8, 2, 64, 512),   # GQA
+    (1, 4, 1, 128, 128),  # MQA
+])
+def test_dense_decode_parity(B, H, Hkv, D, S):
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    lens = jnp.asarray(rng.integers(1, S + 1, B).astype(np.int32))
+    out = dense_decode_attention(q, kc, vc, lens)
+    np.testing.assert_allclose(out, _decode_ref(q, kc, vc, lens),
+                               rtol=1e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("B,H,Hkv,D,ps,P", [
+    (2, 4, 4, 64, 64, 4),
+    (2, 8, 2, 64, 128, 3),  # GQA, non-pow2 page count
+])
+def test_paged_decode_parity(B, H, Hkv, D, ps, P):
+    rng = np.random.default_rng(4)
+    n_pages = B * P + 2
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((n_pages, Hkv, ps, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((n_pages, Hkv, ps, D)), jnp.float32)
+    lens = rng.integers(1, ps * P + 1, B).astype(np.int32)
+    # random non-overlapping physical pages; unused slots -1
+    perm = rng.permutation(n_pages)[: B * P].reshape(B, P)
+    used = (np.arange(P)[None] * ps) < lens[:, None]
+    tables = np.where(used, perm, -1).astype(np.int32)
+
+    out = paged_decode_attention(q, kc, vc, jnp.asarray(tables),
+                                 jnp.asarray(lens))
+
+    # oracle: gather each row's logical cache densely
+    gk = np.zeros((B, Hkv, ps * P, D), np.float32)
+    gv = np.zeros((B, Hkv, ps * P, D), np.float32)
+    for b in range(B):
+        for p in range(P):
+            if tables[b, p] >= 0:
+                gk[b, :, p * ps:(p + 1) * ps] = np.asarray(kc[tables[b, p]])
+                gv[b, :, p * ps:(p + 1) * ps] = np.asarray(vc[tables[b, p]])
+    ref = _decode_ref(q, jnp.asarray(gk), jnp.asarray(gv), jnp.asarray(lens))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
