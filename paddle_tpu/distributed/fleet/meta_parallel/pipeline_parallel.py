@@ -1,27 +1,30 @@
 """PipelineParallel wrapper (reference: python/paddle/distributed/fleet/
 meta_parallel/pipeline_parallel.py — PipelineParallel :242,
 forward_backward_pipeline :684 (1F1B), train_batch :940, interleaved VPP
-:1308).
+:1308; schedule selection fleet/model.py:160-185).
 
 TPU-native execution model: in the reference, pp ranks are processes
 exchanging activations over NCCL p2p in a hand-scheduled 1F1B loop. Under a
-single-controller mesh the schedule is *compiled*: train_batch splits the
-batch into micro-batches and drives them through the stage graph; the
-compiled collective-permute pipeline (paddle_tpu.parallel.pipeline) maps
-stages onto the `pp` mesh axis so micro-batch k+1's stage-0 work overlaps
-micro-batch k's stage-1 work inside one XLA program — the same steady-state
-overlap 1F1B achieves, scheduled by XLA instead of Python.
+single-controller mesh the schedule is *compiled*: when the strategy's
+pp_configs select "1F1B" and the PipelineLayer's stages are uniform (same
+per-stage parameter structure, activation-preserving bodies — the
+transformer-block case), train_batch stacks the per-stage parameters and
+drives the microbatches through paddle_tpu.parallel.pipeline_1f1b — one XLA
+program whose every tick runs a forward AND a backward microbatch per stage,
+accumulating grads in-schedule. The resulting stacked grads are scattered
+back onto the eager Parameters and the optimizer steps as usual.
 
-This wrapper provides the reference API (train_batch with grad accumulation,
-micro-batching, scaler support) with eager semantics; the compiled pipeline
-path is engaged by GPT-style models through paddle_tpu.parallel.pipeline.
+Stages that cannot ride a uniform SPMD program (heterogeneous layer stacks,
+shared embeddings, activation-shape changes) fall back to the sequential
+micro-batch accumulation loop ("FThenB" semantics) — same numerics, no
+overlap.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from ....framework.core import Tensor, no_grad
+from ....framework.core import Parameter, Tensor, no_grad
 from . import pp_layers
 
 __all__ = ["PipelineParallel"]
@@ -38,6 +41,9 @@ class PipelineParallel:
         self.micro_batch_size = strategy.hybrid_configs.get("micro_batch_size") or \
             pp_cfg.get("micro_batch_size", 1)
         self.accumulate_steps = pp_cfg.get("accumulate_steps", 1)
+        self.schedule_mode = pp_cfg.get("schedule_mode", "1F1B")
+        self._compiled = None      # lazily-built compiled 1F1B closure
+        self._compiled_state = 0   # 0 unknown / 1 available / -1 infeasible
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)
@@ -48,9 +54,151 @@ class PipelineParallel:
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
+    # ------------------------------------------------------------------ #
+    # compiled 1F1B route
+    # ------------------------------------------------------------------ #
+
+    def _build_compiled(self):
+        """Return a (x, y, n_micro) -> (loss, set_grads_fn) runner using the
+        compiled 1F1B schedule, or None when the layer structure can't ride
+        a uniform SPMD pipeline."""
+        import jax
+        import jax.numpy as jnp
+
+        from ... import env as _env
+        from ....jit import functional_call
+        from ....parallel.pipeline import microbatch, pipeline_1f1b
+
+        mesh = _env.get_global_mesh()
+        S = mesh.shape.get("pp", 1) if mesh is not None else 1
+        pl = self._layers
+        if S <= 1 or pl.get_num_stages() != S or pl._shared:
+            return None
+        import paddle_tpu.nn as nn
+
+        stages = [pl.get_stage_layers(s) for s in range(S)]
+        if any(fwd is not None or not isinstance(l, nn.Layer)
+               for st in stages for l, fwd in st):
+            return None
+        n_per = len(stages[0])
+        if any(len(st) != n_per for st in stages):
+            return None
+        # stages must be CONSTRUCTED identically, not merely have matching
+        # param shapes — stage 0's layer objects execute every stage's
+        # weights, so differing ctor args (activation, eps, ...) would
+        # silently compute the wrong function
+        parts = pl.segment_parts
+        desc_rows = [pl.descs[parts[s]:parts[s + 1]] for s in range(S)]
+        for row in desc_rows:
+            for j, d in enumerate(row):
+                d0 = desc_rows[0][j]
+                if not (isinstance(d, pp_layers.LayerDesc)
+                        and isinstance(d0, pp_layers.LayerDesc)
+                        and type(d) is type(d0)
+                        and d.layer_func is d0.layer_func
+                        and d.inputs == d0.inputs
+                        and d.kwargs == d0.kwargs):
+                    return None
+        template = [l for l, _ in stages[0]]
+        # per-stage param value lists must be structurally identical
+        names = [[n for n, _ in l.named_parameters()] for l in template]
+        stage_params = []  # [S][layer][pname] -> Parameter
+        for st in stages:
+            per = []
+            for i, (l, _) in enumerate(st):
+                d = dict(l.named_parameters())
+                if sorted(d) != sorted(names[i]):
+                    return None
+                per.append([d[n] for n in names[i]])
+            stage_params.append(per)
+        shapes0 = [[tuple(p.shape) for p in lay] for lay in stage_params[0]]
+        for per in stage_params[1:]:
+            if [[tuple(p.shape) for p in lay] for lay in per] != shapes0:
+                return None
+
+        loss_layer = pl._loss_fn
+        loss_names = ([n for n, _ in loss_layer.named_parameters()]
+                      if isinstance(loss_layer, nn.Layer) else [])
+        loss_tensors = ([dict(loss_layer.named_parameters())[n]
+                         for n in loss_names]
+                        if loss_names else [])
+
+        def stage_fn(pstage, inp):
+            h, y, mb_i = inp
+            for i, l in enumerate(template):
+                out, _ = functional_call(
+                    l, dict(zip(names[i], pstage[i])), {}, [Tensor(h)])
+                h = out
+            return (h, y, mb_i)
+
+        def loss_fn(lp, out):
+            h, y, mb_i = out
+            if isinstance(loss_layer, nn.Layer):
+                loss, _ = functional_call(
+                    loss_layer, dict(zip(loss_names, lp)), {},
+                    [Tensor(h), Tensor(y)])
+                return jnp.asarray(loss).astype(jnp.float32)
+            from ....framework.core import tracing_guard
+
+            with tracing_guard(True):
+                return loss_layer(Tensor(h), Tensor(y))._value.astype(
+                    jnp.float32)
+
+        def runner(x, y, n_micro):
+            stacked = [
+                [jnp.stack([stage_params[s][i][j]._value
+                            for s in range(S)])
+                 for j in range(len(names[i]))]
+                for i in range(n_per)
+            ]
+            lp = [t._value for t in loss_tensors]
+            mb_i = jnp.repeat(jnp.arange(n_micro, dtype=jnp.int32),
+                              x.shape[0] // n_micro)
+            inp_mb = microbatch((x, y, mb_i), n_micro)
+            try:
+                loss, (g_stacked, g_lp) = jax.value_and_grad(
+                    lambda sp, l: pipeline_1f1b(
+                        stage_fn, loss_fn, sp, l, inp_mb, mesh=mesh,
+                        axis="pp"),
+                    (0, 1))(stacked, lp)
+            except (TypeError, ValueError) as e:  # shape-changing stages
+                raise _InfeasibleCompiled(str(e))
+
+            def set_grads():
+                for i in range(n_per):
+                    for j in range(len(names[i])):
+                        g = g_stacked[i][j]
+                        if g is None:
+                            continue
+                        for s in range(S):
+                            p = stage_params[s][i][j]
+                            gv = Tensor(g[s])
+                            p.grad = gv if p.grad is None else p.grad + gv
+                for t, g in zip(loss_tensors, g_lp):
+                    if g is not None:
+                        t.grad = Tensor(g) if t.grad is None else t.grad + Tensor(g)
+
+            return loss, set_grads
+
+        return runner
+
+    def _compiled_runner(self):
+        if self._compiled_state == 0:
+            try:
+                self._compiled = self._build_compiled()
+            except Exception:
+                self._compiled = None
+            self._compiled_state = 1 if self._compiled is not None else -1
+        return self._compiled
+
+    # ------------------------------------------------------------------ #
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Micro-batched forward/backward with grad accumulation
-        (reference train_batch :940 / forward_backward_pipeline :684)."""
+        (reference train_batch :940 / forward_backward_pipeline :684).
+        Routes onto the compiled 1F1B schedule when schedule_mode is
+        "1F1B" and the stage structure allows it; the scaler path and
+        irregular models use the sequential loop."""
         x, y = data
         x = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
         y = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
@@ -62,6 +210,24 @@ class PipelineParallel:
                 f"batch size {total} is not divisible by micro_batch_size {mbs}"
             )
         n_micro = max(total // mbs, 1)
+
+        if (self.schedule_mode.upper() == "1F1B" and scaler is None
+                and n_micro > 1):
+            runner = self._compiled_runner()
+            if runner is not None:
+                try:
+                    loss, set_grads = runner(x._value, y._value, n_micro)
+                except _InfeasibleCompiled:
+                    self._compiled = None
+                    self._compiled_state = -1
+                else:
+                    set_grads()
+                    optimizer.step()
+                    optimizer.clear_grad()
+                    if lr_scheduler is not None:
+                        lr_scheduler.step()
+                    return Tensor(loss)
+
         losses = []
         for m in range(n_micro):
             lo, hi = m * mbs, min((m + 1) * mbs, total)
@@ -100,3 +266,7 @@ class PipelineParallel:
 
     def set_state_dict(self, *a, **kw):
         return self._layers.set_state_dict(*a, **kw)
+
+
+class _InfeasibleCompiled(Exception):
+    pass

@@ -239,6 +239,14 @@ class TrainStep:
         grad_clip = opt._grad_clip
         wd = opt._decay_coeff()
 
+        # models that must see the loss inside their compiled schedule (1F1B
+        # pipelining: the last stage seeds its own backward) expose
+        # forward_loss(inputs..., labels..., criterion) — reference analog:
+        # PipelineParallel owns the loss in train_batch (pipeline_parallel
+        # .py:940) rather than the user loop
+        fused_loss = (getattr(model, "forward_loss", None)
+                      if getattr(model, "pp_schedule", None) == "1f1b" else None)
+
         def compute_loss(p, b, rng, batch):
             saved = state.swap_in(p, b)
             saved_rng = rnd.get_rng_state()
@@ -247,9 +255,15 @@ class TrainStep:
                 with tracing_guard(True):
                     ctx = _amp_ctx(amp_level, amp_dtype)
                     with ctx:
-                        out = model(*_wrap_pytree(list(batch["inputs"])))
-                        outs = out if isinstance(out, (list, tuple)) else [out]
-                        loss = loss_fn(*outs, *_wrap_pytree(list(batch["labels"])))
+                        if fused_loss is not None:
+                            loss = fused_loss(
+                                *_wrap_pytree(list(batch["inputs"])),
+                                *_wrap_pytree(list(batch["labels"])),
+                                loss_fn)
+                        else:
+                            out = model(*_wrap_pytree(list(batch["inputs"])))
+                            outs = out if isinstance(out, (list, tuple)) else [out]
+                            loss = loss_fn(*outs, *_wrap_pytree(list(batch["labels"])))
                 return loss._value.astype(jnp.float32), state.read_buffers()
             finally:
                 state.restore(saved)
@@ -272,25 +286,46 @@ class TrainStep:
                 st = opt_states[k]
                 master = st.get("master")
                 pv = master if master is not None else p[k]
-                gv = grads[k].astype(pv.dtype)
+                # sharding-stage hooks (ZeRO-2/3): reduce-scatter the grad to
+                # its owner shard and compute the update sharded, then
+                # all-gather the fresh params (DistributedTrainStep overrides)
+                gv = self._shard_grad(k, grads[k].astype(pv.dtype))
+                pv = self._shard_param_for_update(k, pv)
                 rule_state = {kk: vv for kk, vv in st.items() if kk != "master"}
                 np_, ns_ = opt.update(pv, gv, rule_state, lr, ctx)
                 if master is not None:
                     ns_ = dict(ns_)
                     ns_["master"] = np_
                     np_ = np_.astype(p[k].dtype)
-                new_p[k] = np_
+                new_p[k] = self._restore_param(k, np_)
                 new_states[k] = ns_
             return loss, new_p, new_states, new_b
 
         donate = (0, 1, 2) if self._donate else ()
-        self._compiled = jax.jit(train_step, donate_argnums=donate)
+        out_sh = self._train_out_shardings()
+        kw = {"out_shardings": out_sh} if out_sh is not None else {}
+        self._compiled = jax.jit(train_step, donate_argnums=donate, **kw)
 
         def eval_step(p, b, rng, batch):
             loss, _ = compute_loss(p, b, rng, batch)
             return loss
 
         self._compiled_eval = jax.jit(eval_step)
+
+    # sharding-stage hooks; identity here, overridden by DistributedTrainStep
+    def _shard_grad(self, name, g):
+        return g
+
+    def _shard_param_for_update(self, name, pv):
+        return pv
+
+    def _restore_param(self, name, np_):
+        return np_
+
+    def _train_out_shardings(self):
+        """Optional out_shardings for (loss, new_p, new_states, new_b) —
+        used by the offload path to keep optimizer states host-resident."""
+        return None
 
     def __call__(self, inputs, labels):
         if self._compiled is None:

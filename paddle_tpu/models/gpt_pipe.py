@@ -38,6 +38,9 @@ from ..distributed.fleet.layers.mpu.mp_layers import (
 )
 from ..parallel.pipeline import (
     microbatch,
+    pack_chunked,
+    pipeline_1f1b,
+    pipeline_interleaved,
     pipeline_spmd,
     unmicrobatch,
 )
@@ -56,12 +59,27 @@ class GPTForCausalLMPipe(nn.Layer):
 
     num_microbatches: microbatch count M for the pipeline (reference
     accumulate_steps, pipeline_parallel.py:940). Ignored when pp == 1.
+
+    pp_schedule selects the compiled schedule (reference schedule_mode in
+    pp_configs + VPP selection, fleet/model.py:160-185):
+      - "gpipe": forward scan, autodiff backward (FThenB-like).
+      - "vpp": interleaved virtual stages, vpp_degree chunks per stage
+        (reference pipeline_parallel.py:1308); needs num_layers divisible
+        by pp*vpp_degree and num_microbatches >= pp.
+      - "1f1b": per-tick mixed fwd/bwd with in-schedule grads
+        (reference :684); engaged through forward_loss() during training
+        (forward() falls back to gpipe for inference).
     """
 
-    def __init__(self, config: GPTConfig, num_microbatches: int = 4):
+    def __init__(self, config: GPTConfig, num_microbatches: int = 4,
+                 pp_schedule: str = "gpipe", vpp_degree: int = 1):
         super().__init__()
         self.config = config
         self.num_microbatches = num_microbatches
+        if pp_schedule not in ("gpipe", "vpp", "1f1b"):
+            raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
+        self.pp_schedule = pp_schedule
+        self.vpp_degree = vpp_degree if pp_schedule == "vpp" else 1
         attr = _init_attr(config)
         self.embed_tokens = VocabParallelEmbedding(
             config.vocab_size, config.hidden_size, weight_attr=attr
@@ -189,19 +207,32 @@ class GPTForCausalLMPipe(nn.Layer):
             )
             stacked = list(stacked_raw)
             if pp > 1:
-                if L % pp != 0:
-                    raise ValueError(f"num_layers {L} not divisible by pp {pp}")
-                lps = L // pp
-                staged = [a.reshape((pp, lps) + a.shape[1:]) for a in stacked]
-                keys_staged = keys.reshape((pp, lps) + keys.shape[1:])
-
+                V = self.vpp_degree
+                if L % (pp * V) != 0:
+                    raise ValueError(
+                        f"num_layers {L} not divisible by pp*vpp {pp * V}")
+                lps = L // (pp * V)
                 mb = h_raw.shape[0] // M
                 mb_idx = jnp.repeat(jnp.arange(M, dtype=jnp.int32), mb)
                 inp_mb = microbatch((h_raw, pos_raw, mb_idx), M)
-                out_mb = pipeline_spmd(
-                    stage_fn, (staged, keys_staged), inp_mb,
-                    mesh=mesh, axis="pp", remat=True,
-                )
+                if V > 1:
+                    vstage_fn = self._stage_fn(training, lps)
+                    chunked = pack_chunked(
+                        [a.reshape((pp * V, lps) + a.shape[1:])
+                         for a in stacked], pp, V)
+                    keys_c = pack_chunked(
+                        keys.reshape((pp * V, lps) + keys.shape[1:]), pp, V)
+                    out_mb = pipeline_interleaved(
+                        vstage_fn, (chunked, keys_c), inp_mb,
+                        mesh=mesh, axis="pp", num_chunks=V,
+                    )
+                else:
+                    staged = [a.reshape((pp, lps) + a.shape[1:]) for a in stacked]
+                    keys_staged = keys.reshape((pp, lps) + keys.shape[1:])
+                    out_mb = pipeline_spmd(
+                        stage_fn, (staged, keys_staged), inp_mb,
+                        mesh=mesh, axis="pp", remat=True,
+                    )
                 out, _, _ = unmicrobatch(out_mb)
                 return out
 
@@ -222,6 +253,120 @@ class GPTForCausalLMPipe(nn.Layer):
         else:
             logits = self.lm_head(h)
         return logits
+
+
+    # ------------------------------------------------------------------ #
+    # 1F1B training path: loss inside the schedule
+    # ------------------------------------------------------------------ #
+
+    def _stage_fn_1f1b(self, training, lps):
+        """Stage body whose activation pytree carries the labels rider so the
+        last stage can seed its own backward (1F1B contract)."""
+        cached = self.__dict__.setdefault("_stage_fn_1f1b_cache", {})
+        k = (training, lps)
+        if k not in cached:
+            layer_fn = self._layer_fn(training)
+
+            def stage_fn(pstage, inp):
+                hh, pos, mb_idx, labels = inp
+                params, keys = pstage
+
+                def scan_body(carry, x):
+                    pslice, key = x
+                    key = jax.random.fold_in(key, mb_idx[0])
+                    return layer_fn(pslice, carry, pos, key), None
+
+                hh, _ = jax.lax.scan(scan_body, hh, (params, keys))
+                return (hh, pos, mb_idx, labels)
+
+            cached[k] = stage_fn
+        return cached[k]
+
+    def _loss_fn_1f1b(self, criterion):
+        """Last-stage head: final_norm -> lm_head -> criterion, applied to
+        raw values (reference: loss_fn as the last PipelineLayer entry,
+        pp_layers.py)."""
+        cached = self.__dict__.setdefault("_loss_fn_1f1b_cache", {})
+        if criterion not in cached:
+            cfg = self.config
+            norm = self.final_norm
+            norm_names = [n for n, _ in norm.named_parameters()]
+
+            from ..jit import functional_call
+
+            def loss_fn(lp, out):
+                hh, pos, mb_idx, labels = out
+                h_n, _ = functional_call(
+                    norm, dict(zip(norm_names, lp["norm"])), {},
+                    [Tensor(hh)], train=False)
+                if cfg.tie_word_embeddings:
+                    logits = jnp.matmul(h_n, lp["head"].T)
+                else:
+                    logits = jnp.matmul(h_n, lp["head"])
+                logits = _constrain(logits, P(None, None, "mp"))
+                loss = criterion(Tensor(logits), Tensor(labels))
+                return loss._value.astype(jnp.float32)
+
+            cached[criterion] = loss_fn
+        return cached[criterion]
+
+    def forward_loss(self, input_ids, labels, criterion):
+        """Mean LM loss via the compiled 1F1B schedule: embedding runs ahead
+        of the pipeline (its grads arrive through the schedule's input
+        cotangents), decoder stages run per-tick mixed fwd/bwd, and
+        final_norm + lm_head + criterion form the last-stage loss that seeds
+        each microbatch's backward (reference forward_backward_pipeline
+        :684). Falls back to forward()+criterion when pp == 1."""
+        cfg = self.config
+        mesh = _env.get_global_mesh()
+        pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if pp <= 1 or self.pp_schedule != "1f1b":
+            return criterion(self.forward(input_ids), labels)
+
+        B, S = input_ids.shape[0], input_ids.shape[1]
+        position_ids = Tensor(jnp.broadcast_to(jnp.arange(S)[None, :], (B, S)))
+        h = self.embed_tokens(input_ids)
+        if not cfg.use_rope:
+            h = h + self.embed_positions(position_ids)
+        h = self.embed_dropout(h)
+
+        training = self.training
+        M = self.num_microbatches
+        L = cfg.num_layers
+        if L % pp != 0:
+            raise ValueError(f"num_layers {L} not divisible by pp {pp}")
+        lps = L // pp
+        stage_fn = self._stage_fn_1f1b(training, lps)
+        loss_fn = self._loss_fn_1f1b(criterion)
+        from ..framework import random as rnd
+
+        norm_params = [p for _, p in self.final_norm.named_parameters()]
+        head_w = (self.embed_tokens.weight if cfg.tie_word_embeddings
+                  else self.lm_head.weight)
+        n_norm = len(norm_params)
+
+        def fused(h_raw, pos_raw, lab_raw, head_raw, *rest):
+            norm_raw = list(rest[:n_norm])
+            stacked = list(rest[n_norm:])
+            base_key = rnd.next_key()
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                jnp.arange(L))
+            staged = [a.reshape((pp, lps) + a.shape[1:]) for a in stacked]
+            keys_staged = keys.reshape((pp, lps) + keys.shape[1:])
+            mb = h_raw.shape[0] // M
+            mb_idx = jnp.repeat(jnp.arange(M, dtype=jnp.int32), mb)
+            inp_mb = microbatch(
+                (h_raw, pos_raw, mb_idx, lab_raw.astype(jnp.int32)), M)
+            lp = {"norm": norm_raw, "head": head_raw}
+            return pipeline_1f1b(
+                stage_fn, loss_fn, (staged, keys_staged), lp, inp_mb,
+                mesh=mesh, axis="pp")
+
+        labels_t = labels if isinstance(labels, Tensor) else Tensor(labels)
+        return run_op(
+            "pp_1f1b_loss", fused,
+            [h, position_ids, labels_t, head_w] + norm_params
+            + self._stacked_tensors())
 
 
 # ------------------------------------------------------------------------- #

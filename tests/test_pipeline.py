@@ -18,6 +18,9 @@ import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.parallel.pipeline import (
     microbatch,
+    pack_chunked,
+    pipeline_1f1b,
+    pipeline_interleaved,
     pipeline_spmd,
     stack_pytrees,
     unmicrobatch,
@@ -80,6 +83,136 @@ class TestPipelineSpmd:
         g2 = jax.grad(loss_ref)(Ws)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
 
+    def test_1f1b_loss_and_grad_parity(self):
+        """1F1B computes the same loss and grads (stage params, loss params,
+        inputs) as plain autodiff of the sequential chain — including int
+        riders flowing through the pipeline untouched (reference parity
+        test: hybrid_parallel_pp_1f1b)."""
+        S, M, mb, H = 4, 8, 2, 16
+        mesh = _pp_mesh(S)
+        rng = np.random.default_rng(2)
+        Ws = jnp.asarray(rng.normal(size=(S, H, H)), jnp.float32) * 0.4
+        Wl = jnp.asarray(rng.normal(size=(H, 1)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(M, mb, H)), jnp.float32)
+        tags = jnp.arange(M * mb, dtype=jnp.int32).reshape(M, mb)
+
+        def stage_fn(W, inp):
+            h, tag = inp
+            return (jnp.tanh(h @ W), tag)
+
+        def loss_fn(lp, out):
+            h, tag = out
+            # rider participates (non-differentiably) so mis-sequencing shows
+            return jnp.mean((h @ lp) ** 2 * (1.0 + 0.01 * tag[:, None]))
+
+        def loss_pipe(Ws, Wl, x):
+            return pipeline_1f1b(stage_fn, loss_fn, Ws, Wl, (x, tags),
+                                 mesh=mesh)
+
+        def loss_ref(Ws, Wl, x):
+            total = 0.0
+            for m in range(M):
+                h = x[m]
+                for i in range(S):
+                    h = jnp.tanh(h @ Ws[i])
+                total = total + loss_fn(Wl, (h, tags[m])) / M
+            return total
+
+        l1 = loss_pipe(Ws, Wl, x)
+        l2 = loss_ref(Ws, Wl, x)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+        g1 = jax.jit(jax.grad(loss_pipe, (0, 1, 2)))(Ws, Wl, x)
+        g2 = jax.grad(loss_ref, (0, 1, 2))(Ws, Wl, x)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_1f1b_degenerate_single_stage(self):
+        mesh = _pp_mesh(1)
+        rng = np.random.default_rng(3)
+        Ws = jnp.asarray(rng.normal(size=(1, 8, 8)), jnp.float32) * 0.4
+        Wl = jnp.asarray(rng.normal(size=(8, 1)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+
+        def stage_fn(W, inp):
+            return (jnp.tanh(inp[0] @ W),)
+
+        def loss_fn(lp, out):
+            return jnp.mean((out[0] @ lp) ** 2)
+
+        l = pipeline_1f1b(stage_fn, loss_fn, Ws, Wl, (x,), mesh=mesh)
+        ref = jnp.mean(jnp.stack([
+            loss_fn(Wl, (jnp.tanh(x[m] @ Ws[0]),)) for m in range(4)]))
+        np.testing.assert_allclose(float(l), float(ref), rtol=1e-5)
+
+    def test_1f1b_peak_memory_below_gpipe(self):
+        """The 1F1B ring buffer (W = 2S-1 stage inputs) must beat the
+        autodiff'd GPipe scan's T = M + S - 1 stashed residuals (reference
+        claim: pipeline_parallel.py 1F1B memory motivation)."""
+        S, M, mb, H = 4, 32, 4, 256
+        mesh = _pp_mesh(S)
+        rng = np.random.default_rng(4)
+        Ws = jnp.asarray(rng.normal(size=(S, H, H)), jnp.float32) * 0.3
+        Wl = jnp.asarray(rng.normal(size=(H, 1)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(M, mb, H)), jnp.float32)
+
+        def stage_fn(W, inp):
+            return (jnp.tanh(inp[0] @ W),)
+
+        def loss_fn(lp, out):
+            return jnp.mean((out[0] @ lp) ** 2)
+
+        def loss_1f1b(Ws, Wl, x):
+            return pipeline_1f1b(stage_fn, loss_fn, Ws, Wl, (x,), mesh=mesh)
+
+        def loss_gpipe(Ws, Wl, x):
+            (o,) = pipeline_spmd(stage_fn, Ws, (x,), mesh=mesh)
+            return jnp.mean(
+                jnp.stack([loss_fn(Wl, (o[m],)) for m in range(M)]))
+
+        def peak(f):
+            c = jax.jit(jax.grad(f, (0, 1, 2))).lower(Ws, Wl, x).compile()
+            ma = c.memory_analysis()
+            return ma.temp_size_in_bytes
+
+        p_1f1b, p_gpipe = peak(loss_1f1b), peak(loss_gpipe)
+        assert p_1f1b < p_gpipe, (p_1f1b, p_gpipe)
+
+    def test_interleaved_forward_and_grad_parity(self):
+        """VPP circular schedule == sequential chain of S*V virtual stages
+        (reference interleaved 1F1B parity, hybrid_parallel_pp_vpp)."""
+        S, V, M, mb, H = 2, 3, 4, 2, 16
+        mesh = _pp_mesh(S)
+        rng = np.random.default_rng(5)
+        Ws = jnp.asarray(rng.normal(size=(S * V, H, H)), jnp.float32) * 0.4
+        x = jnp.asarray(rng.normal(size=(M, mb, H)), jnp.float32)
+
+        def stage_fn(W, inp):
+            (h,) = inp
+            return (jnp.tanh(h @ W),)
+
+        def run_vpp(Ws, x):
+            (o,) = pipeline_interleaved(
+                stage_fn, pack_chunked(Ws, S, V), (x,), mesh=mesh,
+                num_chunks=V)
+            return o
+
+        def run_ref(Ws, x):
+            h = x
+            for u in range(S * V):
+                h = jnp.tanh(h @ Ws[u])
+            return h
+
+        np.testing.assert_allclose(
+            np.asarray(run_vpp(Ws, x)), np.asarray(run_ref(Ws, x)),
+            atol=1e-5)
+
+        g1 = jax.jit(jax.grad(lambda W: (run_vpp(W, x) ** 2).sum()))(Ws)
+        g2 = jax.grad(lambda W: (run_ref(W, x) ** 2).sum())(Ws)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_stack_unstack_roundtrip(self):
         trees = [{"w": jnp.ones((2,)) * i} for i in range(3)]
         stacked = stack_pytrees(trees)
@@ -126,6 +259,59 @@ class TestGPTPipe:
             layered(ids).numpy(), pipe(ids).numpy(), atol=1e-4
         )
 
+    def test_vpp_forward_matches_scan(self):
+        from paddle_tpu.models import gpt3_tiny, GPTForCausalLMPipe
+
+        paddle.seed(0)
+        cfg = gpt3_tiny()
+        cfg.num_layers = 4
+        pipe = GPTForCausalLMPipe(cfg, num_microbatches=4,
+                                  pp_schedule="vpp", vpp_degree=2)
+        pipe.eval()
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)))
+        dist.env.build_mesh(dp=1, devices=jax.devices()[:1])
+        out_scan = pipe(ids).numpy()
+        dist.env.build_mesh(pp=2, devices=jax.devices()[:2])
+        out_vpp = pipe(ids).numpy()
+        dist.env.set_global_mesh(None)
+        np.testing.assert_allclose(out_scan, out_vpp, atol=1e-4)
+
+    def test_1f1b_train_step_matches_gpipe(self):
+        """Same init, same data: the 1F1B train step must follow the same
+        loss trajectory as the GPipe-autodiff step (reference parity between
+        schedule_mode settings, hybrid_parallel_pp_1f1b)."""
+        from paddle_tpu.models import (
+            GPTForCausalLMPipe, GPTPretrainingCriterion, gpt3_tiny)
+        import paddle_tpu.optimizer as opt
+
+        def run(schedule):
+            paddle.seed(0)
+            cfg = gpt3_tiny()
+            cfg.num_layers = 4
+            cfg.hidden_dropout_prob = 0.0
+            cfg.attention_dropout_prob = 0.0
+            pipe = GPTForCausalLMPipe(cfg, num_microbatches=2,
+                                      pp_schedule=schedule)
+            crit = GPTPretrainingCriterion(cfg)
+            pipe.train()
+            mesh = dist.build_mesh(pp=2)
+            optimizer = opt.AdamW(learning_rate=1e-3,
+                                  parameters=pipe.parameters())
+            step = dist.DistributedTrainStep(
+                pipe, lambda lg, lb: crit(lg, lb), optimizer, mesh=mesh)
+            rng = np.random.default_rng(7)
+            ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (4, 16)))
+            labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (4, 16)))
+            losses = [float(step(ids, labels)) for _ in range(4)]
+            dist.env.set_global_mesh(None)
+            return losses
+
+        l_gpipe = run("gpipe")
+        l_1f1b = run("1f1b")
+        np.testing.assert_allclose(l_1f1b, l_gpipe, rtol=2e-3, atol=2e-4)
+        assert l_1f1b[-1] < l_1f1b[0]
+
     def test_hybrid_train_step_dp_pp_mp(self):
         from paddle_tpu.models import GPTPretrainingCriterion
         import paddle_tpu.optimizer as opt
@@ -165,3 +351,93 @@ class TestPipelineLayerWrapper:
         x = paddle.to_tensor(np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32))
         out = pl(x)
         assert tuple(out.shape) == (4, 8)
+
+    def test_train_batch_compiled_1f1b_route(self):
+        """With schedule_mode 1F1B and uniform stages, train_batch must run
+        the compiled pipeline (reference: PipelineParallel selects 1F1B in
+        fleet/model.py:160-185) and match the sequential loop numerically."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+            DistributedStrategy,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            PipelineParallel,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            LayerDesc,
+            PipelineLayer,
+        )
+
+        rng = np.random.default_rng(1)
+        x = np.asarray(rng.normal(size=(8, 16)), np.float32)
+        y = np.asarray(rng.normal(size=(8, 16)), np.float32)
+
+        def run(schedule):
+            paddle.seed(0)
+            descs = [LayerDesc(nn.Linear, 16, 16) for _ in range(4)]
+            pl = PipelineLayer(descs, num_stages=2, loss_fn=nn.MSELoss())
+            strat = DistributedStrategy()
+            strat.hybrid_configs = {
+                "pp_configs": {"micro_batch_size": 2,
+                               "schedule_mode": schedule},
+            }
+            pp = PipelineParallel(pl, None, strat)
+            optimizer = opt.SGD(learning_rate=0.05,
+                                parameters=pl.parameters())
+            losses = [
+                float(pp.train_batch(
+                    (paddle.to_tensor(x), paddle.to_tensor(y)),
+                    optimizer).numpy())
+                for _ in range(3)
+            ]
+            return pp, losses
+
+        dist.env.build_mesh(pp=2, devices=jax.devices()[:2])
+        pp1, l_1f1b = run("1F1B")
+        assert pp1._compiled_state == 1, "compiled 1F1B path not engaged"
+        pp2, l_seq = run("FThenB")
+        assert pp2._compiled_state == 0, "FThenB must not build compiled path"
+        dist.env.set_global_mesh(None)
+        np.testing.assert_allclose(l_1f1b, l_seq, rtol=1e-4, atol=1e-5)
+        assert l_1f1b[-1] < l_1f1b[0]
+
+    def test_compiled_route_rejects_nonuniform_stages(self):
+        """Stages with identical param shapes but different construction
+        must fall back to the eager loop (stage-0's layer objects would
+        otherwise execute every stage's weights)."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+            DistributedStrategy,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            PipelineParallel,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            LayerDesc,
+            PipelineLayer,
+        )
+
+        paddle.seed(0)
+        descs = [
+            LayerDesc(nn.Linear, 16, 16),
+            LayerDesc(nn.Linear, 16, 16),
+            LayerDesc(nn.Linear, 16, 16, bias_attr=False),  # differs
+            LayerDesc(nn.Linear, 16, 16, bias_attr=False),
+        ]
+        pl = PipelineLayer(descs, num_stages=2, loss_fn=nn.MSELoss())
+        strat = DistributedStrategy()
+        strat.hybrid_configs = {
+            "pp_configs": {"micro_batch_size": 2, "schedule_mode": "1F1B"},
+        }
+        dist.env.build_mesh(pp=2, devices=jax.devices()[:2])
+        pp = PipelineParallel(pl, None, strat)
+        optimizer = opt.SGD(learning_rate=0.05, parameters=pl.parameters())
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(np.asarray(rng.normal(size=(4, 16)), np.float32))
+        y = paddle.to_tensor(np.asarray(rng.normal(size=(4, 16)), np.float32))
+        loss = pp.train_batch((x, y), optimizer)
+        dist.env.set_global_mesh(None)
+        assert pp._compiled_state == -1, "nonuniform stages must not compile"
+        assert np.isfinite(float(loss.numpy()))
