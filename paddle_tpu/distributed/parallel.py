@@ -69,6 +69,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     if stage == 3:
         shard_params_for_stage3(model)
     optimizer._sharding_stage = stage
+    optimizer._sharding_offload = bool(offload)
     model._sharding_stage = stage
     return model, optimizer, scaler
 

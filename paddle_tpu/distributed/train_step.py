@@ -44,6 +44,9 @@ def fsdp_spec(shape, axis="sharding", mesh=None, existing=None):
     for i, s in enumerate(base):
         if s is not None:
             used.add(i)
+            # axis already mapped (e.g. stage-3 params feeding _update_spec)
+            if s == axis or (isinstance(s, tuple) and axis in s):
+                return P(*base)
     # pick largest divisible unused dim
     cands = [
         (shape[i], i) for i in range(len(shape))
@@ -66,11 +69,15 @@ def shard_params_for_stage3(model, axis="sharding", mesh=None):
 
 class DistributedTrainStep(TrainStep):
     def __init__(self, model, loss_fn, optimizer, mesh=None,
-                 input_specs=None, label_specs=None, sharding_stage=0,
-                 batch_axes=("dp", "sharding"), **kw):
+                 input_specs=None, label_specs=None, sharding_stage=None,
+                 offload=False, batch_axes=("dp", "sharding"), **kw):
         self.mesh = mesh or _env.default_mesh()
         _env.set_global_mesh(self.mesh)
+        if sharding_stage is None:
+            # group_sharded_parallel() annotates the optimizer
+            sharding_stage = getattr(optimizer, "_sharding_stage", 0)
         self.sharding_stage = sharding_stage
+        self.offload = offload or getattr(optimizer, "_sharding_offload", False)
         self.batch_axes = tuple(a for a in batch_axes if self.mesh.shape.get(a, 1) >= 1)
         self.input_specs = input_specs
         self.label_specs = label_specs
@@ -99,18 +106,56 @@ class DistributedTrainStep(TrainStep):
             return pspec
         return P()
 
-    def _sharding(self, spec):
-        return NamedSharding(self.mesh, spec if spec is not None else P())
+    def _update_spec(self, name):
+        """The spec the optimizer update runs under: the grad's owner shard
+        (reference: GroupShardedStage2 reduce-scatter-to-rank,
+        group_sharded_stage2.py:47)."""
+        pspec = self._param_spec(name)
+        if self.sharding_stage in (2, 3) and self.mesh.shape.get("sharding", 1) > 1:
+            s = fsdp_spec(tuple(self._state.params[name].shape),
+                          "sharding", self.mesh, pspec)
+            if s is not None:
+                return s
+        return pspec
+
+    def _shard_grad(self, name, g):
+        spec = self._update_spec(name)
+        if spec == self._param_spec(name):
+            return g
+        # XLA lowers this to a reduce-scatter over ICI instead of the
+        # all-reduce the replicated-grad path would use
+        return jax.lax.with_sharding_constraint(g, self._sharding(spec))
+
+    def _shard_param_for_update(self, name, pv):
+        spec = self._update_spec(name)
+        if spec == self._param_spec(name):
+            return pv
+        return jax.lax.with_sharding_constraint(pv, self._sharding(spec))
+
+    def _restore_param(self, name, np_):
+        # all-gather fresh shards back to the param layout (stage 2; stage 3
+        # params stay sharded because _param_spec == _update_spec there)
+        return jax.lax.with_sharding_constraint(
+            np_, self._sharding(self._param_spec(name)))
+
+    def _sharding(self, spec, host=False):
+        kind = "pinned_host" if host else None
+        return NamedSharding(self.mesh, spec if spec is not None else P(),
+                             memory_kind=kind)
 
     def _place_state(self):
-        """device_put params/opt-states/buffers with their shardings."""
+        """device_put params/opt-states/buffers with their shardings; with
+        offload=True the optimizer states (and master weights) live in host
+        memory between steps (reference: GroupSharded cpu offload,
+        group_sharded_stage3.py offload params / sharding_optimizer)."""
         for k, v in self.params.items():
             self.params[k] = jax.device_put(v, self._sharding(self._param_spec(k)))
         for k, st in self.opt_states.items():
             for sk, sv in st.items():
                 if hasattr(sv, "shape"):
                     st[sk] = jax.device_put(
-                        sv, self._sharding(self._opt_state_spec(k, sk, sv))
+                        sv, self._sharding(self._opt_state_spec(k, sk, sv),
+                                           host=self.offload)
                     )
         for k, v in self.buffers.items():
             self.buffers[k] = jax.device_put(v, self._sharding(P()))
@@ -124,7 +169,20 @@ class DistributedTrainStep(TrainStep):
             return P()
         return P(axes if len(axes) > 1 else axes[0])
 
+    def _move_opt_states(self, host):
+        for k, st in self.opt_states.items():
+            for sk, sv in st.items():
+                if hasattr(sv, "shape"):
+                    st[sk] = jax.device_put(
+                        sv, self._sharding(self._opt_state_spec(k, sk, sv),
+                                           host=host))
+
     def __call__(self, inputs, labels):
+        if self.offload:
+            # stream optimizer states host→device for the update and back
+            # afterwards (reference: GroupSharded offload=True keeping the
+            # moments on CPU between steps, group_sharded_stage3.py offload)
+            self._move_opt_states(host=False)
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
@@ -135,4 +193,7 @@ class DistributedTrainStep(TrainStep):
         lb_specs = self.label_specs or [self._batch_spec(a) for a in raw_lb]
         placed_in = [jax.device_put(a, self._sharding(s)) for a, s in zip(raw_in, in_specs)]
         placed_lb = [jax.device_put(a, self._sharding(s)) for a, s in zip(raw_lb, lb_specs)]
-        return super().__call__([Tensor(a) for a in placed_in], [Tensor(a) for a in placed_lb])
+        loss = super().__call__([Tensor(a) for a in placed_in], [Tensor(a) for a in placed_lb])
+        if self.offload:
+            self._move_opt_states(host=True)
+        return loss
