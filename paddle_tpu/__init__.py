@@ -109,3 +109,5 @@ from . import distributed  # noqa: E402
 from .distributed import DataParallel  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
+from . import profiler  # noqa: E402
+from . import device  # noqa: E402

@@ -119,6 +119,17 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
 amp_decorate = decorate
 
 
+import jax as _jax
+
+
+@_jax.jit
+def _unscale_and_check(grads, inv):
+    scaled = [g * inv.astype(g.dtype) for g in grads]
+    found = jnp.any(jnp.stack(
+        [jnp.any(~jnp.isfinite(g.astype(jnp.float32))) for g in scaled]))
+    return scaled, found
+
+
 class GradScaler:
     """reference: python/paddle/amp/grad_scaler.py:657. With bf16 (TPU default)
     scaling is the identity; with fp16 the full dynamic-loss-scale state
@@ -144,30 +155,38 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
+        """Fused on-device unscale + finite check: ONE compiled program over
+        all grads and ONE device→host sync at the step decision (the
+        reference's check_finite_and_unscale kernel,
+        paddle/phi/kernels/gpu/check_finite_and_unscale_kernel.cu — NOT a
+        per-tensor host round-trip)."""
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found_inf = False
+        holders = []
         for p in optimizer._parameter_list or []:
             params = p["params"] if isinstance(p, dict) else [p]
-            for q in params:
-                if q.grad is not None:
-                    gv = q.grad._value
-                    if self._scale != 1.0:
-                        gv = gv * inv
-                        q.grad._value = gv
-                    if not bool(jnp.all(jnp.isfinite(gv))):
-                        found_inf = True
-        self._found_inf = found_inf
+            holders.extend(q for q in params if q.grad is not None)
+        if not holders:
+            self._found_inf = False
+            return
+        grads = [q.grad._value for q in holders]
+        scaled, found = _unscale_and_check(
+            grads, jnp.float32(1.0 / self._scale))
+        if self._scale != 1.0:
+            for q, g in zip(holders, scaled):
+                q.grad._value = g
+        self._found_inf = found  # device scalar; synced once in step()
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if self._found_inf:
+        if bool(self._found_inf):  # the single host sync
+            self._found_inf = True
             self._update_on_inf()
             return
+        self._found_inf = False
         optimizer.step()
         self._update_on_good()
 
@@ -223,3 +242,6 @@ class GradScaler:
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("incr_count", 0)
         self._bad_steps = state.get("decr_count", 0)
+
+from . import debugging  # noqa: E402
+__all__.append("debugging")

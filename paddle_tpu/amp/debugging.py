@@ -1,0 +1,183 @@
+"""Numeric debugging (reference: python/paddle/amp/debugging.py —
+TensorCheckerConfig/enable_tensor_checker :56, check_numerics :321,
+DebugMode, collect_operator_stats; kernel-side nan/inf scan
+paddle/fluid/eager/nan_inf_utils.cc and FLAGS_check_nan_inf).
+
+TPU formulation: the eager dispatcher exposes an op-result hook
+(framework.core.set_op_check_hook); enabling the checker installs a
+device-side isfinite reduction over every op's outputs and raises (or
+logs) with the op name on the first non-finite value. Inside compiled
+programs use `check_numerics` directly (it is jit-traceable via
+jax.lax.cond-free arithmetic and debug_callback)."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from enum import Enum
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework.core import Tensor
+
+__all__ = [
+    "DebugMode",
+    "TensorCheckerConfig",
+    "enable_tensor_checker",
+    "disable_tensor_checker",
+    "check_numerics",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection",
+    "collect_operator_stats",
+]
+
+
+class DebugMode(Enum):
+    """reference debugging.py DebugMode."""
+
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    """reference debugging.py:56 — enable_check, debug_mode, op black/white
+    lists (checked_op_list / skipped_op_list)."""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+
+
+class NumericError(RuntimeError):
+    pass
+
+
+_findings: list[str] = []
+
+
+def _iter_values(result):
+    if isinstance(result, Tensor):
+        yield result._value
+    elif isinstance(result, (list, tuple)):
+        for r in result:
+            yield from _iter_values(r)
+
+
+def _make_hook(config: TensorCheckerConfig):
+    def hook(op_name, result):
+        if config.checked_op_list and op_name not in config.checked_op_list:
+            return
+        if op_name in config.skipped_op_list:
+            return
+        for v in _iter_values(result):
+            if not jnp.issubdtype(v.dtype, jnp.inexact):
+                continue
+            if isinstance(v, jax.core.Tracer):
+                # compiled paths must use check_numerics explicitly — an
+                # eager host sync cannot run inside a trace
+                continue
+            finite = bool(jnp.all(jnp.isfinite(v)))
+            if not finite:
+                n_nan = int(jnp.sum(jnp.isnan(v)))
+                n_inf = int(jnp.sum(jnp.isinf(v)))
+                msg = (f"[check_nan_inf] op `{op_name}` produced "
+                       f"{n_nan} NaN / {n_inf} Inf values "
+                       f"(shape {tuple(v.shape)}, dtype {v.dtype})")
+                _findings.append(msg)
+                if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                    raise NumericError(msg)
+                import warnings
+
+                warnings.warn(msg)
+
+    return hook
+
+
+_active_config: TensorCheckerConfig | None = None
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """reference debugging.py enable_tensor_checker (and the
+    FLAGS_check_nan_inf runtime flag)."""
+    global _active_config
+    _active_config = checker_config
+    if checker_config.enable:
+        _core.set_op_check_hook(_make_hook(checker_config))
+
+
+def disable_tensor_checker():
+    global _active_config
+    _active_config = None
+    _core.set_op_check_hook(None)
+
+
+def check_numerics(tensor, op_type="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """One-shot numeric scan of a tensor (reference debugging.py:321).
+    Returns (num_nan, num_inf, num_zero) like the reference's stats path."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n_nan = int(jnp.sum(jnp.isnan(v)))
+    n_inf = int(jnp.sum(jnp.isinf(v)))
+    n_zero = int(jnp.sum(v == 0))
+    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise NumericError(
+            f"[check_numerics] {op_type}:{var_name} has {n_nan} NaN / "
+            f"{n_inf} Inf")
+    import numpy as np
+
+    return (jnp.asarray(n_nan), jnp.asarray(n_inf), jnp.asarray(n_zero))
+
+
+# --------------------------------------------------------------------------- #
+# operator stats (reference collect_operator_stats / low-precision op list)
+# --------------------------------------------------------------------------- #
+
+_op_stats: defaultdict | None = None
+
+
+def _stats_hook(op_name, result):
+    dtypes = {str(v.dtype) for v in _iter_values(result)}
+    for dt in dtypes or {"-"}:
+        _op_stats[op_name][dt] += 1
+
+
+def enable_operator_stats_collection():
+    """Count eager op calls per output dtype (reference
+    debugging.py enable_operator_stats_collection — used to audit which ops
+    ran in fp16/bf16 under AMP)."""
+    global _op_stats
+    _op_stats = defaultdict(lambda: defaultdict(int))
+    _core.set_op_check_hook(_stats_hook)
+
+
+def disable_operator_stats_collection():
+    _core.set_op_check_hook(None)
+    stats = _op_stats
+    if stats:
+        print("<------------------- op list ------------------->")
+        for op, by_dt in sorted(stats.items()):
+            counts = ", ".join(f"{dt}: {c}" for dt, c in sorted(by_dt.items()))
+            print(f"  {op:<40} {counts}")
+        print("<----------------- op list end ----------------->")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def operator_stats():
+    return {k: dict(v) for k, v in (_op_stats or {}).items()}

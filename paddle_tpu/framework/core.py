@@ -765,7 +765,46 @@ def as_tensors(args) -> list[Tensor]:
     return [a if isinstance(a, Tensor) else to_tensor(a) for a in args]
 
 
+# Op event hook (profiler): fn(op_name, start_ns, end_ns) called around every
+# eager dispatch — the host-side analog of the reference's RecordEvent
+# instrumentation in the generated ad_func bodies
+# (paddle/fluid/eager/api/manual/eager_manual/forwards/*.cc RecordEvent).
+_op_event_hook: Callable | None = None
+
+# Op check hook (amp.debugging / FLAGS_check_nan_inf): fn(op_name, result)
+# called on every eager dispatch result; may raise — the analog of the
+# reference's CheckTensorHasNanOrInf pass (paddle/fluid/eager/nan_inf_utils.cc).
+_op_check_hook: Callable | None = None
+
+
+def set_op_event_hook(fn):
+    global _op_event_hook
+    _op_event_hook = fn
+
+
+def set_op_check_hook(fn):
+    global _op_check_hook
+    _op_check_hook = fn
+
+
 def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = None):
+    ev, ck = _op_event_hook, _op_check_hook
+    if ev is None and ck is None:
+        return _run_op_impl(name, fn, inputs, n_outputs)
+    import time
+
+    t0 = time.perf_counter_ns() if ev is not None else 0
+    try:
+        out = _run_op_impl(name, fn, inputs, n_outputs)
+    finally:
+        if ev is not None:
+            ev(name, t0, time.perf_counter_ns())
+    if ck is not None:
+        ck(name, out)
+    return out
+
+
+def _run_op_impl(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = None):
     """Execute `fn(*raw_values)` and record it on the tape when needed.
 
     This is the entire analog of the reference's generated `<op>_ad_func` entry

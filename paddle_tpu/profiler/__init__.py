@@ -1,0 +1,43 @@
+"""Profiler subsystem (reference: python/paddle/profiler/profiler.py:358
+Profiler, :89 ProfilerState, :129 make_scheduler, :227 export_chrome_tracing;
+chrome-trace writer paddle/fluid/platform/profiler/chrometracing_logger.cc;
+RecordEvent python/paddle/profiler/utils.py).
+
+TPU-native split: host-side events (eager op dispatch spans via the run_op
+hook, user RecordEvent annotations, dataloader/step timing) are collected by
+this package and exported as chrome-trace JSON + summary tables — the analog
+of the reference's CPU RecordEvent stream. Device-side timelines come from
+XLA's own profiler: pass `device_trace_dir` (or use targets containing
+ProfilerTarget.TPU with on_trace_ready=export_chrome_tracing(dir)) and the
+Profiler brackets each RECORD window with jax.profiler.start_trace/
+stop_trace, producing an XPlane/perfetto trace viewable in XProf — replacing
+the reference's CUPTI tracer (paddle/fluid/platform/profiler/cuda_tracer.cc).
+RecordEvent doubles as a jax.profiler.TraceAnnotation so host annotations
+appear on the device timeline too.
+"""
+
+from .profiler import (
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    SummaryView,
+    export_chrome_tracing,
+    export_protobuf,
+    load_profiler_result,
+    make_scheduler,
+)
+from .timer import benchmark
+
+__all__ = [
+    "Profiler",
+    "ProfilerState",
+    "ProfilerTarget",
+    "RecordEvent",
+    "SummaryView",
+    "benchmark",
+    "export_chrome_tracing",
+    "export_protobuf",
+    "load_profiler_result",
+    "make_scheduler",
+]

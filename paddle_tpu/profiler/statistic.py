@@ -1,0 +1,54 @@
+"""Summary statistics over the host event stream (reference:
+python/paddle/profiler/profiler_statistic.py — per-op aggregation and the
+formatted summary tables)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+_UNIT = {"s": 1e-9, "ms": 1e-6, "us": 1e-3, "ns": 1.0}
+
+
+def aggregate(events):
+    """name -> dict(calls, total_ns, avg_ns, min_ns, max_ns, cat)."""
+    agg = {}
+    for e in events:
+        d = agg.get(e.name)
+        dur = e.end_ns - e.start_ns
+        if d is None:
+            agg[e.name] = d = dict(calls=0, total=0, mn=None, mx=0, cat=e.cat)
+        d["calls"] += 1
+        d["total"] += dur
+        d["mn"] = dur if d["mn"] is None else min(d["mn"], dur)
+        d["mx"] = max(d["mx"], dur)
+    return agg
+
+
+def build_summary(events, time_unit="ms"):
+    """Formatted per-category tables sorted by total time (reference
+    profiler_statistic.py _build_table)."""
+    scale = _UNIT.get(time_unit, 1e-6)
+    agg = aggregate(events)
+    if not agg:
+        return "no profiler events recorded"
+    by_cat = defaultdict(list)
+    for name, d in agg.items():
+        by_cat[d["cat"]].append((name, d))
+    grand = sum(d["total"] for d in agg.values()) or 1
+
+    out = []
+    width = max([len(n) for n in agg] + [20])
+    for cat in sorted(by_cat):
+        rows = sorted(by_cat[cat], key=lambda kv: -kv[1]["total"])
+        out.append(f"\n{'-' * (width + 58)}")
+        out.append(f"Category: {cat}   (time unit: {time_unit})")
+        out.append(f"{'-' * (width + 58)}")
+        out.append(f"{'Name'.ljust(width)}  {'Calls':>7}  {'Total':>10}  "
+                   f"{'Avg':>10}  {'Min':>10}  {'Max':>10}  {'Ratio':>6}")
+        for name, d in rows:
+            t, c = d["total"], d["calls"]
+            out.append(
+                f"{name.ljust(width)}  {c:>7}  {t * scale:>10.3f}  "
+                f"{t / c * scale:>10.3f}  {d['mn'] * scale:>10.3f}  "
+                f"{d['mx'] * scale:>10.3f}  {t / grand:>6.1%}")
+    return "\n".join(out)

@@ -1,0 +1,84 @@
+"""amp.debugging nan/inf checker + operator stats + device memory stats
+(reference: python/paddle/amp/debugging.py:56,321;
+paddle/fluid/eager/nan_inf_utils.cc; paddle/phi/core/memory/stats.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp.debugging import (
+    DebugMode,
+    NumericError,
+    TensorCheckerConfig,
+    check_numerics,
+    collect_operator_stats,
+    disable_tensor_checker,
+    enable_tensor_checker,
+    operator_stats,
+)
+
+
+def test_tensor_checker_aborts_on_nan():
+    cfg = TensorCheckerConfig(
+        enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT)
+    enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(NumericError, match="divide"):
+            _ = x / paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    finally:
+        disable_tensor_checker()
+    # hook uninstalled: the same op no longer raises
+    bad = x / paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    assert not np.isfinite(bad.numpy()).all()
+
+
+def test_tensor_checker_warn_mode_and_skip_list():
+    cfg = TensorCheckerConfig(
+        enable=True, debug_mode=DebugMode.CHECK_NAN_INF,
+        skipped_op_list={"divide"})
+    enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        z = paddle.to_tensor(np.array([0.0], np.float32))
+        _ = x / z  # skipped op: no warning, no raise
+        with pytest.warns(UserWarning, match="log"):
+            _ = paddle.log(z - 1.0)
+    finally:
+        disable_tensor_checker()
+
+
+def test_check_numerics():
+    t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], np.float32))
+    with pytest.raises(NumericError):
+        check_numerics(t, "op", "t")
+    n_nan, n_inf, n_zero = check_numerics(
+        t, "op", "t", debug_mode=DebugMode.CHECK_NAN_INF)
+    assert (int(n_nan), int(n_inf), int(n_zero)) == (1, 1, 1)
+
+
+def test_collect_operator_stats(capsys):
+    with collect_operator_stats():
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        _ = paddle.matmul(x, x)
+        _ = x + x
+        stats = operator_stats()
+    assert "matmul" in stats
+    assert any("float32" in dt for dt in stats["matmul"])
+    out = capsys.readouterr().out
+    assert "op list" in out and "matmul" in out
+
+
+def test_device_memory_stats():
+    import paddle_tpu.device as device
+
+    base = device.memory_allocated()
+    x = paddle.to_tensor(np.ones((256, 256), np.float32))
+    allocated = device.memory_allocated()
+    assert allocated >= base
+    assert device.max_memory_allocated() >= allocated
+    stats = device.memory_stats()
+    assert "bytes_in_use" in stats and "peak_bytes_in_use" in stats
+    device.reset_max_memory_allocated()
+    assert device.max_memory_allocated() <= device.memory_allocated() + 1
+    del x

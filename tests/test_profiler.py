@@ -1,0 +1,114 @@
+"""Profiler subsystem tests (reference test model:
+test/legacy_test/test_profiler.py + python/paddle/profiler scheduler docs).
+"""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (
+    Profiler,
+    ProfilerState,
+    RecordEvent,
+    benchmark,
+    export_chrome_tracing,
+    make_scheduler,
+)
+
+
+def test_make_scheduler_state_machine():
+    """skip_first=1, closed=1, ready=1, record=4, repeat=1: batches 0 skipped,
+    1 closed, 2 ready, [3,6] record with 6 RECORD_AND_RETURN — the reference
+    docstring example (profiler.py:129)."""
+    sched = make_scheduler(closed=1, ready=1, record=4, repeat=1, skip_first=1)
+    want = [
+        ProfilerState.CLOSED,   # 0 skipped
+        ProfilerState.CLOSED,   # 1
+        ProfilerState.READY,    # 2
+        ProfilerState.RECORD,   # 3
+        ProfilerState.RECORD,
+        ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN,  # 6
+        ProfilerState.CLOSED,   # repeat exhausted
+    ]
+    assert [sched(i) for i in range(8)] == want
+
+
+def test_profiler_records_ops_and_exports(tmp_path):
+    """Op dispatch spans + RecordEvent annotations land in a loadable
+    chrome trace, and summary() aggregates them."""
+    traces = []
+
+    def on_ready(prof):
+        path = os.path.join(tmp_path, f"trace_{prof.step_num}.json")
+        prof.export(path)
+        traces.append(path)
+
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    with Profiler(scheduler=make_scheduler(closed=0, ready=1, record=2,
+                                           repeat=1),
+                  on_trace_ready=on_ready) as p:
+        for i in range(4):
+            with RecordEvent("train_iter"):
+                y = (paddle.matmul(x, x) + 1.0).sum()
+            p.step()
+
+    assert traces, "on_trace_ready never fired"
+    doc = json.load(open(traces[0]))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "train_iter" in names
+    assert any(n in names for n in ("matmul", "add", "sum")), names
+    cats = {e["cat"] for e in doc["traceEvents"]}
+    assert "operator" in cats and "user_defined" in cats
+    for e in doc["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+    s = p.summary()
+    assert "train_iter" in s and "Calls" in s
+
+
+def test_profiler_closed_state_records_nothing():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    p = Profiler(scheduler=make_scheduler(closed=10, ready=0, record=1))
+    p.start()
+    _ = (x + x).sum()
+    p.step()
+    p.stop()
+    assert p.events() == []
+    # the op-event hook must be uninstalled after stop
+    from paddle_tpu.framework import core
+
+    assert core._op_event_hook is None
+
+
+def test_export_chrome_tracing_handler(tmp_path):
+    d = os.path.join(tmp_path, "log")
+    handler = export_chrome_tracing(d)
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with Profiler(scheduler=(1, 2), on_trace_ready=handler) as p:
+        for _ in range(3):
+            _ = x * 2.0
+            p.step()
+    files = os.listdir(d)
+    assert any(f.endswith(".paddle_trace.json") for f in files), files
+
+
+def test_step_info_and_benchmark():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with Profiler(timer_only=True) as p:
+        for _ in range(3):
+            _ = x + 1.0
+            p.step()
+    info = p.step_info()
+    assert "ips" in info and "batch_cost" in info
+
+    b = benchmark()
+    b.begin()
+    b.after_reader()
+    b.after_step(num_samples=32)
+    b.end()
+    assert "ips" in b.step_info()
+    assert b.ips > 0
