@@ -103,6 +103,23 @@ def _make_hook(config: TensorCheckerConfig):
 
 _active_config: TensorCheckerConfig | None = None
 
+# The core exposes one op-check hook slot; the checker and the stats
+# collector each own a sub-slot here so enabling one never uninstalls the
+# other.
+_hooks: dict[str, object] = {}
+
+
+def _sync_hooks():
+    if not _hooks:
+        _core.set_op_check_hook(None)
+        return
+
+    def dispatch(op_name, result):
+        for fn in list(_hooks.values()):
+            fn(op_name, result)
+
+    _core.set_op_check_hook(dispatch)
+
 
 def enable_tensor_checker(checker_config: TensorCheckerConfig):
     """reference debugging.py enable_tensor_checker (and the
@@ -110,30 +127,36 @@ def enable_tensor_checker(checker_config: TensorCheckerConfig):
     global _active_config
     _active_config = checker_config
     if checker_config.enable:
-        _core.set_op_check_hook(_make_hook(checker_config))
+        _hooks["checker"] = _make_hook(checker_config)
+    else:
+        _hooks.pop("checker", None)
+    _sync_hooks()
 
 
 def disable_tensor_checker():
     global _active_config
     _active_config = None
-    _core.set_op_check_hook(None)
+    _hooks.pop("checker", None)
+    _sync_hooks()
+
+
+@jax.jit
+def _count_stats(v):
+    return (jnp.sum(jnp.isnan(v)), jnp.sum(jnp.isinf(v)), jnp.sum(v == 0))
 
 
 def check_numerics(tensor, op_type="", var_name="",
                    debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
     """One-shot numeric scan of a tensor (reference debugging.py:321).
-    Returns (num_nan, num_inf, num_zero) like the reference's stats path."""
+    Returns (num_nan, num_inf, num_zero) like the reference's stats path —
+    one fused device reduction, one host sync."""
     v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
-    n_nan = int(jnp.sum(jnp.isnan(v)))
-    n_inf = int(jnp.sum(jnp.isinf(v)))
-    n_zero = int(jnp.sum(v == 0))
-    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+    n_nan, n_inf, n_zero = _count_stats(v)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and int(n_nan + n_inf):
         raise NumericError(
-            f"[check_numerics] {op_type}:{var_name} has {n_nan} NaN / "
-            f"{n_inf} Inf")
-    import numpy as np
-
-    return (jnp.asarray(n_nan), jnp.asarray(n_inf), jnp.asarray(n_zero))
+            f"[check_numerics] {op_type}:{var_name} has {int(n_nan)} NaN / "
+            f"{int(n_inf)} Inf")
+    return (n_nan, n_inf, n_zero)
 
 
 # --------------------------------------------------------------------------- #
@@ -155,11 +178,13 @@ def enable_operator_stats_collection():
     ran in fp16/bf16 under AMP)."""
     global _op_stats
     _op_stats = defaultdict(lambda: defaultdict(int))
-    _core.set_op_check_hook(_stats_hook)
+    _hooks["stats"] = _stats_hook
+    _sync_hooks()
 
 
 def disable_operator_stats_collection():
-    _core.set_op_check_hook(None)
+    _hooks.pop("stats", None)
+    _sync_hooks()
     stats = _op_stats
     if stats:
         print("<------------------- op list ------------------->")

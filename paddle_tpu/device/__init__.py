@@ -103,9 +103,18 @@ def memory_allocated(device=None) -> int:
 
 
 def max_memory_allocated(device=None) -> int:
-    """reference max_memory_allocated (stats.cc peak tracking)."""
+    """reference max_memory_allocated (stats.cc peak tracking).
+
+    PJRT's peak counter cannot be rewound, so after
+    reset_max_memory_allocated() this reports the real peak only once it
+    exceeds the recorded baseline; until then it reports current usage."""
+    d = _dev(device)
     s = memory_stats(device)
-    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+    peak = int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+    base = _reset_baseline.get(d.id)
+    if base is not None and peak <= base:
+        return int(s.get("bytes_in_use", 0))
+    return peak
 
 
 def memory_reserved(device=None) -> int:
@@ -118,6 +127,15 @@ def max_memory_reserved(device=None) -> int:
     return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
 
 
+_reset_baseline: dict[int, int] = {}
+
+
 def reset_max_memory_allocated(device=None):
     d = _dev(device)
     _peaks[d.id] = _live_bytes(d)
+    try:
+        s = d.memory_stats()
+    except Exception:
+        s = None
+    if s:
+        _reset_baseline[d.id] = int(s.get("peak_bytes_in_use", 0))

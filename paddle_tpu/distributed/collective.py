@@ -192,13 +192,42 @@ def _is_stacked(tensor, group):
     return tensor.ndim >= 1 and tensor.shape[0] == group.nranks
 
 
+def _mp_active(group):
+    """The cross-process eager backend when jax.distributed has N > 1
+    controllers (multi-controller CPU/TPU pods), else None. Subgroup eager
+    collectives are refused rather than silently wrong."""
+    from . import eager_multiproc as mp
+
+    n = mp.nprocs()
+    if n <= 1:
+        return None
+    if group.nranks not in (n,):
+        raise NotImplementedError(
+            "eager collectives over subgroups are not supported in "
+            "multi-process mode; use the compiled shard_map primitives")
+    return mp
+
+
+def _op_name(op):
+    return op if isinstance(op, str) else str(op)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Every rank slice becomes the group reduction. For a stacked global
     array [nranks, ...] this reduces over the rank axis; XLA turns it into an
     ICI all-reduce when the axis is sharded. Updates `tensor` in place and
-    returns a task, like the reference."""
+    returns a task, like the reference. Under multi-controller
+    (jax.process_count() > 1) each process contributes its local tensor and
+    the reduction runs over the global device set."""
+    import jax.numpy as jnp
+
     g = _grp(group)
     if g.nranks == 1:
+        return _Task(tensor)
+    mp = _mp_active(g)
+    if mp is not None:
+        tensor._value = jnp.asarray(
+            mp.allreduce_value(np.asarray(tensor._value), _op_name(op)))
         return _Task(tensor)
     if _is_stacked(tensor, g):
         tensor._value = _reduce_stacked(tensor._value, op, g.nranks)
@@ -210,12 +239,18 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    import jax.numpy as jnp
+
     g = _grp(group)
     if g.nranks == 1:
         return _Task(tensor)
+    mp = _mp_active(g)
+    if mp is not None:
+        red = mp.allreduce_value(np.asarray(tensor._value), _op_name(op))
+        if mp.rank() == dst:
+            tensor._value = jnp.asarray(red)
+        return _Task(tensor)
     if _is_stacked(tensor, g):
-        import jax.numpy as jnp
-
         red = _reduce_stacked(tensor._value, op, g.nranks)
         # only dst's slice carries the result; others keep their input
         idx = g.get_group_rank(dst) if dst in g.ranks else dst
@@ -225,8 +260,17 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """reference: dist.all_gather(list, t) — after the call the list holds
-    every rank's tensor. Global-array view: slices of the stacked array."""
+    every rank's tensor. Global-array view: slices of the stacked array;
+    multi-controller: one compiled all-gather over the processes."""
     g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        import jax.numpy as jnp
+
+        rows = mp.allgather_values(np.asarray(tensor._value))
+        for i in range(rows.shape[0]):
+            tensor_list.append(Tensor(jnp.asarray(rows[i])))
+        return _Task()
     if _is_stacked(tensor, g) and tensor.ndim >= 1:
         for i in range(g.nranks):
             tensor_list.append(Tensor(tensor._value[i]))
@@ -238,6 +282,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 def all_gather_object(object_list, obj, group=None):
     g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        object_list.extend(mp.allgather_objects(obj))
+        return _Task()
     for _ in range(g.nranks):
         object_list.append(obj)
     return _Task()
@@ -250,6 +298,15 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
 
     g = _grp(group)
     vals = [t._value if isinstance(t, Tensor) else jnp.asarray(t) for t in tensor_list]
+    mp = _mp_active(g)
+    if mp is not None:
+        # rank r's output = reduction over processes of their tensor_list[r]
+        rows = mp.allgather_values(np.stack([np.asarray(v) for v in vals]))
+        mine = rows[:, mp.rank()]  # [nprocs, ...]
+        red = {"sum": np.sum, "max": np.max, "min": np.min,
+               "prod": np.prod, "avg": np.mean}[_op_name(op)](mine, axis=0)
+        tensor._value = jnp.asarray(red)
+        return _Task(tensor)
     stacked = jnp.stack(vals, axis=0)  # [nranks(dst), nranks(src)?...]
     if vals[0].ndim >= 1 and vals[0].shape[0] == g.nranks:
         # each list entry is itself stacked per-source: reduce over source
@@ -263,26 +320,42 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
+    import jax.numpy as jnp
+
     g = _grp(group)
     if g.nranks == 1:
         return _Task(tensor)
+    mp = _mp_active(g)
+    if mp is not None:
+        tensor._value = jnp.asarray(
+            mp.broadcast_value(np.asarray(tensor._value), src))
+        return _Task(tensor)
     if _is_stacked(tensor, g):
-        import jax.numpy as jnp
-
         idx = g.get_group_rank(src) if src in g.ranks else src
         tensor._value = jnp.broadcast_to(tensor._value[idx:idx + 1], tensor._value.shape)
     return _Task(tensor)
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        object_list[:] = mp.broadcast_objects(list(object_list), src)
     return _Task()
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    g = _grp(group)
-    if tensor_list:
-        import jax.numpy as jnp
+    import jax.numpy as jnp
 
+    g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        payload = ([np.asarray(t._value) for t in tensor_list]
+                   if mp.rank() == src and tensor_list else None)
+        rows = mp.allgather_objects(payload)
+        tensor._value = jnp.asarray(rows[src][mp.rank()])
+        return _Task(tensor)
+    if tensor_list:
         stacked = jnp.stack([t._value for t in tensor_list], axis=0)
         r = max(g.rank, 0)
         tensor._value = stacked[r]
@@ -290,6 +363,13 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        payload = in_object_list if mp.rank() == src else None
+        rows = mp.allgather_objects(payload)
+        out_object_list.append(rows[src][mp.rank()])
+        return _Task()
     if in_object_list:
         out_object_list.append(in_object_list[0])
     return _Task()
@@ -302,6 +382,12 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = _grp(group)
     n = g.nranks
     vals = [t._value for t in in_tensor_list]
+    mp = _mp_active(g)
+    if mp is not None:
+        rows = mp.allgather_values(np.stack([np.asarray(v) for v in vals]))
+        for j in range(n):  # out[j] = what process j put at slot my_rank
+            out_tensor_list.append(Tensor(jnp.asarray(rows[j, mp.rank()])))
+        return _Task()
     # single-controller stacked view: in_tensor_list[j][i] is what rank i
     # sends to rank j when entries are stacked; plain view: identity permute
     if vals and vals[0].ndim >= 1 and vals[0].shape[0] == n:
@@ -330,15 +416,25 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
             "unequal split sizes are not supported by the eager "
             "alltoall_single; use equal chunks or the compiled primitives"
         )
+    mp = _mp_active(g)
+    if mp is not None:
+        out_tensor._value = jnp.asarray(
+            mp.alltoall_single_value(np.asarray(v), n))
+        return _Task(out_tensor)
     if n > 1 and v.ndim >= 1 and v.shape[0] % (n * n) == 0:
         # full stacked view: [src(n) * dst(n) * per, ...]
         per = v.shape[0] // (n * n)
         grid = v.reshape(n, n, per, *v.shape[1:])  # [src, dst, per, ...]
         out_tensor._value = jnp.swapaxes(grid, 0, 1).reshape(v.shape)
+    elif n > 1:
+        # the stacked-view heuristic cannot represent this shape; a silent
+        # identity here would be wrong data, not a degraded mode
+        raise ValueError(
+            f"eager alltoall_single needs a [src*dst*k, ...] stacked view "
+            f"(leading dim divisible by nranks^2={n * n}); got shape "
+            f"{tuple(v.shape)}. Use the compiled primitives inside "
+            f"shard_map for per-rank tensors.")
     else:
-        # replicated single-rank view: every rank holds the same array and
-        # sends chunk j to rank j — with identical inputs the result is the
-        # input (chunk j received from every src is the same chunk j)
         out_tensor._value = v
     return _Task(out_tensor)
 
@@ -354,13 +450,29 @@ _mailbox: dict = {}
 
 def send(tensor, dst=0, group=None, sync_op=True):
     g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        from .store import create_or_get_global_tcp_store
+
+        mp.p2p_send(create_or_get_global_tcp_store(), tensor._value,
+                    mp.rank(), dst)
+        return _Task()
     src = max(g.rank, 0)
     _mailbox.setdefault((g.id, src, dst), []).append(tensor._value)
     return _Task()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    import jax.numpy as jnp
+
     g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        from .store import create_or_get_global_tcp_store
+
+        tensor._value = jnp.asarray(
+            mp.p2p_recv(create_or_get_global_tcp_store(), src, mp.rank()))
+        return _Task(tensor)
     me = max(g.rank, 0)
     # single-controller: the process plays every rank, so src/dst stamps on
     # both sides reflect the controller's rank, not the emulated one. Match
@@ -405,6 +517,11 @@ def batch_isend_irecv(p2p_op_list):
 def barrier(group=None):
     import jax
 
+    g = _grp(group)
+    mp = _mp_active(g)
+    if mp is not None:
+        mp.barrier()
+        return _Task()
     jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
     return _Task()
 

@@ -1,0 +1,136 @@
+"""Eager cross-process collectives for the multi-controller path.
+
+Reference analog: the eager ProcessGroupNCCL/Gloo collectives
+(paddle/phi/core/distributed/collective/process_group_nccl.cc, python API
+python/paddle/distributed/communication/*.py) used by dygraph DataParallel.
+
+TPU formulation: when `jax.distributed` is initialized with N > 1 processes,
+each controller owns a slice of the global device set. Eager collectives are
+built on jax's multihost utilities — process_allgather stages host-local
+values into a global array and runs ONE compiled all-gather over ICI/DCN,
+after which each process reduces/selects locally. Object collectives ride
+the same path via pickle + uint8 staging. P2P send/recv rendezvous through
+the native TCPStore (native/tcp_store.cc), the same store that bootstraps
+the job — the analog of the reference's ncclSend/Recv over a store-brokered
+ring (paddle/phi/core/distributed/store/tcp_store.h).
+
+These paths are engaged by paddle_tpu.distributed.collective when
+process_count() > 1; the compiled shard_map primitives remain the
+performance path inside jitted programs.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+
+def _mu():
+    from jax.experimental import multihost_utils
+
+    return multihost_utils
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def nprocs() -> int:
+    try:
+        return _jax().process_count()
+    except Exception:
+        return 1
+
+
+def rank() -> int:
+    return _jax().process_index()
+
+
+def allgather_values(v):
+    """[nprocs, ...] stacked gather of a host-local array (one compiled
+    all-gather over the global device set)."""
+    return np.asarray(_mu().process_allgather(np.asarray(v), tiled=False))
+
+
+def allreduce_value(v, op="sum"):
+    g = allgather_values(v)
+    if op in ("sum",):
+        return g.sum(axis=0)
+    if op in ("max",):
+        return g.max(axis=0)
+    if op in ("min",):
+        return g.min(axis=0)
+    if op in ("prod",):
+        return g.prod(axis=0)
+    if op in ("avg",):
+        return g.mean(axis=0)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather_objects(obj):
+    """Pickle-based object all-gather (reference all_gather_object,
+    communication/all_gather.py)."""
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    n = int(payload.size)
+    lens = allgather_values(np.asarray([n], np.int64))[:, 0]
+    cap = int(lens.max())
+    padded = np.zeros(cap, np.uint8)
+    padded[:n] = payload
+    rows = allgather_values(padded)
+    return [pickle.loads(rows[i, : int(lens[i])].tobytes())
+            for i in range(rows.shape[0])]
+
+
+def broadcast_value(v, src):
+    return allgather_values(v)[src]
+
+
+def broadcast_objects(objs, src):
+    return allgather_objects(objs)[src]
+
+
+def barrier(name="paddle_tpu_barrier"):
+    _mu().sync_global_devices(name)
+
+
+def alltoall_single_value(v, n):
+    """Equal-split single-tensor all-to-all: row-chunk j of every process's
+    input lands on process j, concatenated in source order."""
+    if v.shape[0] % n != 0:
+        raise ValueError(
+            f"alltoall_single: leading dim {v.shape[0]} not divisible by "
+            f"world size {n}")
+    g = allgather_values(v)  # [src, rows, ...]
+    per = v.shape[0] // n
+    r = rank()
+    return np.concatenate(
+        [g[j, r * per:(r + 1) * per] for j in range(n)], axis=0)
+
+
+# --------------------------------------------------------------------------- #
+# P2P over the native TCPStore
+# --------------------------------------------------------------------------- #
+
+_seq: dict = {}
+
+
+def p2p_send(store, value, src, dst):
+    key = f"p2p/{src}->{dst}/{_seq.setdefault((src, dst), 0)}"
+    _seq[(src, dst)] += 1
+    store.set(key, pickle.dumps(np.asarray(value)))
+
+
+def p2p_recv(store, src, dst):
+    key = f"p2p/{src}->{dst}/{_seq.setdefault((src, dst), 0)}"
+    _seq[(src, dst)] += 1
+    store.wait([key])
+    out = pickle.loads(store.get(key))
+    # consume: long-running send/recv loops must not grow the store
+    try:
+        store.delete_key(key)
+    except Exception:
+        pass
+    return out

@@ -229,6 +229,7 @@ class Profiler:
         if self._recording:
             return
         self._recording = True
+        self._events = []  # each record window exports only its own events
         if self.timer_only:
             return
         if self.record_op_events:
@@ -290,9 +291,15 @@ class Profiler:
         self._transition(self.scheduler(0))
 
     def stop(self) -> None:
+        """Flush any in-flight record window (the reference invokes the
+        trace handler on stop whenever the profiler is recording)."""
         global _active_profiler
-        if self.current_state == ProfilerState.RECORD_AND_RETURN:
-            self._transition(ProfilerState.CLOSED)  # flush via transition
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._end_record()
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+            self.current_state = ProfilerState.CLOSED
         else:
             self._end_record()
         if _active_profiler is self:

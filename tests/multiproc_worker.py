@@ -1,0 +1,100 @@
+"""Worker body for tests/test_multiproc.py — runs under jax.distributed with
+N controller processes on localhost (reference analog: the per-rank body of
+test/legacy_test/test_parallel_dygraph_dataparallel.py:30 workers).
+
+Asserts eager cross-process collectives, TCPStore p2p, and DP train-step
+parity between the global dp=N mesh and a process-local single-device run.
+Exits 0 on success; any assertion failure propagates as a nonzero exit.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    dist.init_parallel_env()
+    import jax
+
+    assert jax.process_count() == world, jax.process_count()
+    assert dist.get_rank() == rank
+
+    # --- all_reduce sum / max ------------------------------------------- #
+    t = paddle.to_tensor(np.full((4,), rank + 1.0, np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), world * (world + 1) / 2.0)
+    t2 = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+    dist.all_reduce(t2, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(t2.numpy(), world - 1.0)
+
+    # --- all_gather ------------------------------------------------------ #
+    lst = []
+    dist.all_gather(lst, paddle.to_tensor(np.asarray([rank], np.int32)))
+    assert [int(x.numpy()[0]) for x in lst] == list(range(world))
+
+    # --- broadcast (tensor + object, variable-size payloads) ------------- #
+    b = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+    dist.broadcast(b, src=1)
+    np.testing.assert_allclose(b.numpy(), 1.0)
+    objs = [{"rank": rank, "blob": "x" * (5 * (rank + 1))}]
+    dist.broadcast_object_list(objs, src=0)
+    assert objs[0]["rank"] == 0
+
+    gathered = []
+    dist.all_gather_object(gathered, {"r": rank, "pad": "y" * (10 * (rank + 1))})
+    assert [o["r"] for o in gathered] == list(range(world))
+
+    # --- alltoall_single -------------------------------------------------- #
+    a = paddle.to_tensor(np.full((world, 2), float(rank), np.float32))
+    out = paddle.to_tensor(np.zeros((world, 2), np.float32))
+    dist.alltoall_single(out, a)
+    np.testing.assert_allclose(
+        out.numpy(), np.arange(world, dtype=np.float32)[:, None]
+        * np.ones((1, 2), np.float32))
+
+    # --- p2p over the native TCPStore ------------------------------------ #
+    if world >= 2:
+        if rank == 0:
+            dist.send(paddle.to_tensor(np.arange(5.0, dtype=np.float32)), dst=1)
+        elif rank == 1:
+            r = paddle.to_tensor(np.zeros(5, np.float32))
+            dist.recv(r, src=0)
+            np.testing.assert_allclose(r.numpy(), np.arange(5.0))
+    dist.barrier()
+
+    # --- DP train-step parity: global dp=world mesh vs local run --------- #
+    def run(mesh):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 4))
+        crit = nn.MSELoss()
+        step = dist.DistributedTrainStep(
+            model, lambda o, y: crit(o, y),
+            opt.AdamW(learning_rate=1e-2, parameters=model.parameters()),
+            mesh=mesh)
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(np.asarray(rng.normal(size=(8, 16)), np.float32))
+        y = paddle.to_tensor(np.asarray(rng.normal(size=(8, 4)), np.float32))
+        out = [float(step(x, y)) for _ in range(3)]
+        dist.env.set_global_mesh(None)
+        return out
+
+    global_losses = run(dist.build_mesh(dp=world))
+    local_losses = run(dist.build_mesh(dp=1, devices=jax.local_devices()))
+    np.testing.assert_allclose(global_losses, local_losses,
+                               rtol=2e-4, atol=1e-5)
+
+    print(json.dumps({"rank": rank, "losses": global_losses}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -185,10 +185,16 @@ class TestFusedMoE:
 
 class TestGlobalScatterGather:
     def test_round_trip(self):
+        import paddle_tpu.distributed as dist
         from paddle_tpu.distributed.utils import global_gather, global_scatter
 
+        # the [src*dst*k, ...] stacked view needs the group size explicit —
+        # alltoall_single now rejects shapes it cannot interpret instead of
+        # silently returning the input
+        grp = dist.new_group(list(range(4)))
         x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(16, 1))
         cnt = paddle.to_tensor(np.full((4,), 4, np.int64))
-        s = global_scatter(x, cnt, cnt)
-        g = global_gather(s, cnt, cnt)
+        s = global_scatter(x, cnt, cnt, group=grp)
+        assert not np.allclose(s.numpy(), x.numpy())  # exchange happened
+        g = global_gather(s, cnt, cnt, group=grp)
         np.testing.assert_allclose(g.numpy(), x.numpy())
