@@ -111,3 +111,7 @@ from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
 from . import device  # noqa: E402
+from . import fft  # noqa: E402
+from . import distribution  # noqa: E402
+from . import static  # noqa: E402
+from .static import disable_static, enable_static  # noqa: E402

@@ -1,0 +1,352 @@
+"""Probability distributions (reference: python/paddle/distribution/ —
+distribution.py Distribution base, normal.py, uniform.py, categorical.py,
+bernoulli.py, kl.py kl_divergence registry).
+
+TPU formulation: sampling draws keys from the framework RNG
+(framework.random) and every density/statistic is a differentiable run_op
+over jnp — distributions compose with autograd, jit, and shard_map like any
+other op. Reparameterized sampling (rsample) is native: samples are pure
+functions of (key, params), so gradients flow to the parameters."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "Distribution",
+    "Normal",
+    "Uniform",
+    "Categorical",
+    "Bernoulli",
+    "Exponential",
+    "kl_divergence",
+    "register_kl",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _f32(x):
+    t = _t(x)
+    if not jnp.issubdtype(t._value.dtype, jnp.floating):
+        t = Tensor(t._value.astype(jnp.float32))
+    return t
+
+
+class Distribution:
+    """reference: distribution/distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return run_op("dist_prob", lambda lp: jnp.exp(lp),
+                      [self.log_prob(value)])
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """reference: distribution/normal.py Normal (loc/scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return run_op("normal_var", lambda s: s * s, [self.scale])
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(loc, scale):
+            eps = jax.random.normal(key, shp, dtype=loc.dtype)
+            return loc + scale * eps
+
+        return run_op("normal_rsample", fn, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return run_op("normal_log_prob", fn,
+                      [_f32(value), self.loc, self.scale])
+
+    def entropy(self):
+        def fn(loc, scale):
+            return jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale),
+                jnp.broadcast_shapes(loc.shape, scale.shape))
+
+        return run_op("normal_entropy", fn, [self.loc, self.scale])
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    """reference: distribution/uniform.py Uniform (low/high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _f32(low)
+        self.high = _f32(high)
+        shape = jnp.broadcast_shapes(self.low._value.shape,
+                                     self.high._value.shape)
+        super().__init__(batch_shape=shape)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(low, high):
+            u = jax.random.uniform(key, shp, dtype=low.dtype)
+            return low + (high - low) * u
+
+        return run_op("uniform_rsample", fn, [self.low, self.high])
+
+    def log_prob(self, value):
+        def fn(v, low, high):
+            inside = (v >= low) & (v < high)
+            return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+        return run_op("uniform_log_prob", fn,
+                      [_f32(value), self.low, self.high])
+
+    def entropy(self):
+        return run_op("uniform_entropy",
+                      lambda low, high: jnp.log(high - low),
+                      [self.low, self.high])
+
+
+class Categorical(Distribution):
+    """reference: distribution/categorical.py Categorical(logits)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _f32(logits)
+        super().__init__(batch_shape=self.logits._value.shape[:-1])
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(logits):
+            return jax.random.categorical(key, logits, shape=shp)
+
+        return run_op("categorical_sample", fn, [self.logits])
+
+    @staticmethod
+    def _gather_last(table, v):
+        """table [*B, K] gathered at v [*S, *B] -> [*S, *B] (sample dims
+        broadcast against the batch dims)."""
+        v = v.astype(jnp.int32)
+        tb = jnp.broadcast_to(table, v.shape + table.shape[-1:])
+        return jnp.take_along_axis(tb, v[..., None], axis=-1)[..., 0]
+
+    def log_prob(self, value):
+        def fn(v, logits):
+            return self._gather_last(jax.nn.log_softmax(logits, axis=-1), v)
+
+        return run_op("categorical_log_prob", fn, [_t(value), self.logits])
+
+    def probs(self, value=None):
+        p = run_op("categorical_probs",
+                   lambda l: jax.nn.softmax(l, axis=-1), [self.logits])
+        if value is None:
+            return p
+        return run_op("categorical_probs_at",
+                      lambda pr, v: self._gather_last(pr, v), [p, _t(value)])
+
+    def entropy(self):
+        def fn(logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+        return run_op("categorical_entropy", fn, [self.logits])
+
+
+class Bernoulli(Distribution):
+    """reference: distribution/bernoulli.py Bernoulli(probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_t = _f32(probs)
+        super().__init__(batch_shape=self.probs_t._value.shape)
+
+    @property
+    def mean(self):
+        return self.probs_t
+
+    @property
+    def variance(self):
+        return run_op("bernoulli_var", lambda p: p * (1 - p), [self.probs_t])
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(p):
+            return jax.random.bernoulli(key, p, shape=shp).astype(p.dtype)
+
+        return run_op("bernoulli_sample", fn, [self.probs_t])
+
+    def log_prob(self, value):
+        def fn(v, p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return run_op("bernoulli_log_prob", fn, [_f32(value), self.probs_t])
+
+    def entropy(self):
+        def fn(p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return run_op("bernoulli_entropy", fn, [self.probs_t])
+
+
+class Exponential(Distribution):
+    """reference: distribution/exponential.py Exponential(rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _f32(rate)
+        super().__init__(batch_shape=self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return run_op("exp_mean", lambda r: 1.0 / r, [self.rate])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(rate):
+            u = jax.random.uniform(key, shp, dtype=rate.dtype,
+                                   minval=1e-7, maxval=1.0)
+            return -jnp.log(u) / rate
+
+        return run_op("exp_rsample", fn, [self.rate])
+
+    def log_prob(self, value):
+        def fn(v, rate):
+            return jnp.where(v >= 0, jnp.log(rate) - rate * v, -jnp.inf)
+
+        return run_op("exp_log_prob", fn, [_f32(value), self.rate])
+
+    def entropy(self):
+        return run_op("exp_entropy", lambda r: 1.0 - jnp.log(r), [self.rate])
+
+
+# --------------------------------------------------------------------------- #
+# KL divergence registry (reference: distribution/kl.py register_kl)
+# --------------------------------------------------------------------------- #
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    """reference: paddle.distribution.kl_divergence."""
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def fn(l1, s1, l2, s2):
+        var1, var2 = s1 * s1, s2 * s2
+        return (jnp.log(s2 / s1) + (var1 + (l1 - l2) ** 2) / (2 * var2) - 0.5)
+
+    return run_op("kl_normal", fn, [p.loc, p.scale, q.loc, q.scale])
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    def fn(lp, lq):
+        a = jax.nn.log_softmax(lp, axis=-1)
+        b = jax.nn.log_softmax(lq, axis=-1)
+        return jnp.sum(jnp.exp(a) * (a - b), axis=-1)
+
+    return run_op("kl_categorical", fn, [p.logits, q.logits])
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def fn(al, ah, bl, bh):
+        covered = (bl <= al) & (ah <= bh)
+        return jnp.where(covered, jnp.log((bh - bl) / (ah - al)), jnp.inf)
+
+    return run_op("kl_uniform", fn, [p.low, p.high, q.low, q.high])
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    def fn(a, b):
+        eps = 1e-7
+        a = jnp.clip(a, eps, 1 - eps)
+        b = jnp.clip(b, eps, 1 - eps)
+        return a * (jnp.log(a) - jnp.log(b)) + (1 - a) * (
+            jnp.log1p(-a) - jnp.log1p(-b))
+
+    return run_op("kl_bernoulli", fn, [p.probs_t, q.probs_t])

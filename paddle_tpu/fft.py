@@ -1,0 +1,106 @@
+"""FFT module (reference: python/paddle/fft.py — fft/ifft/rfft/... built on
+phi's cuFFT/onemkl kernels, paddle/phi/kernels/gpu/fft_kernel.cu).
+
+TPU formulation: XLA owns the FFT lowering (HLO FftOp); every function is a
+thin differentiable run_op over jnp.fft, so fft ops fuse into surrounding
+jitted programs and work inside to_static/TrainStep like any other op."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _norm(norm):
+    # paddle uses "backward"/"ortho"/"forward" like numpy
+    return norm or "backward"
+
+
+def _mk1d(jfn, opname):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
+        return run_op(opname, lambda v: jfn(v, n=n, axis=axis,
+                                            norm=_norm(norm)), [_t(x)])
+
+    f.__name__ = opname
+    f.__doc__ = f"reference: python/paddle/fft.py {opname}. XLA FFT lowering."
+    return f
+
+
+def _mk2d(jfn, opname):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return run_op(opname, lambda v: jfn(v, s=s, axes=axes,
+                                            norm=_norm(norm)), [_t(x)])
+
+    f.__name__ = opname
+    f.__doc__ = f"reference: python/paddle/fft.py {opname}. XLA FFT lowering."
+    return f
+
+
+def _mkn(jfn, opname):
+    def f(x, s=None, axes=None, norm="backward", name=None):
+        return run_op(opname, lambda v: jfn(v, s=s, axes=axes,
+                                            norm=_norm(norm)), [_t(x)])
+
+    f.__name__ = opname
+    f.__doc__ = f"reference: python/paddle/fft.py {opname}. XLA FFT lowering."
+    return f
+
+
+fft = _mk1d(jnp.fft.fft, "fft")
+ifft = _mk1d(jnp.fft.ifft, "ifft")
+rfft = _mk1d(jnp.fft.rfft, "rfft")
+irfft = _mk1d(jnp.fft.irfft, "irfft")
+hfft = _mk1d(jnp.fft.hfft, "hfft")
+ihfft = _mk1d(jnp.fft.ihfft, "ihfft")
+
+fft2 = _mk2d(jnp.fft.fft2, "fft2")
+ifft2 = _mk2d(jnp.fft.ifft2, "ifft2")
+rfft2 = _mk2d(jnp.fft.rfft2, "rfft2")
+irfft2 = _mk2d(jnp.fft.irfft2, "irfft2")
+
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    """reference: paddle.fft.fftfreq."""
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    """reference: paddle.fft.rfftfreq."""
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    """reference: paddle.fft.fftshift."""
+    return run_op("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), [_t(x)])
+
+
+def ifftshift(x, axes=None, name=None):
+    """reference: paddle.fft.ifftshift."""
+    return run_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), [_t(x)])

@@ -787,9 +787,21 @@ def set_op_check_hook(fn):
     _op_check_hook = fn
 
 
+# Op recorder (paddle.static Program capture): fn(op_name, fn, inputs, result)
+# called after every eager dispatch — the analog of the reference's static
+# Program op-desc appending under program_guard (python/paddle/base/
+# framework.py append_op).
+_op_recorder: Callable | None = None
+
+
+def set_op_recorder(fn):
+    global _op_recorder
+    _op_recorder = fn
+
+
 def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = None):
-    ev, ck = _op_event_hook, _op_check_hook
-    if ev is None and ck is None:
+    ev, ck, rec = _op_event_hook, _op_check_hook, _op_recorder
+    if ev is None and ck is None and rec is None:
         return _run_op_impl(name, fn, inputs, n_outputs)
     import time
 
@@ -801,6 +813,8 @@ def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = No
             ev(name, t0, time.perf_counter_ns())
     if ck is not None:
         ck(name, out)
+    if rec is not None:
+        rec(name, fn, inputs, out)
     return out
 
 

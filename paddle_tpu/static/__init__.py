@@ -1,0 +1,359 @@
+"""paddle.static Program/Executor surface (reference:
+python/paddle/base/framework.py Program :5890 / program_guard :7480,
+python/paddle/base/executor.py Executor :1256, python/paddle/static/
+input.py data, python/paddle/static/nn/common.py fc).
+
+TPU formulation: a Program is a *recorded op trace*. Under program_guard the
+eager dispatcher's recorder hook (framework.core.set_op_recorder) appends
+every run_op (name, fn, inputs, outputs) to the program while the ops also
+execute eagerly on placeholder zeros — construction doubles as shape
+inference (the reference's infer-shape pass). Executor.run replays the
+recorded ops as ONE pure jax function of the feeds (placeholders bound by
+name, parameters read live so optimizer updates are visible) and jits it —
+the new-executor + PIR lowering collapse into a jax.jit. Re-running with new
+feed shapes retraces; repeated shapes hit the jit cache.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework.core import Tensor
+from ..framework.dtype import convert_dtype
+
+__all__ = [
+    "Program",
+    "enable_static",
+    "disable_static",
+    "in_static_mode",
+    "program_guard",
+    "default_main_program",
+    "default_startup_program",
+    "data",
+    "InputSpec",
+    "Executor",
+    "CompiledProgram",
+    "Variable",
+    "global_scope",
+    "scope_guard",
+    "name_scope",
+    "cpu_places",
+    "cuda_places",
+    "nn",
+]
+
+Variable = Tensor  # the one-type design: static Variables ARE Tensors
+
+
+class Program:
+    """reference framework.py:5890 — here a recorded op trace."""
+
+    def __init__(self):
+        self._ops = []            # (name, fn, input_entries, output_ids)
+        self._placeholders = {}   # feed name -> Tensor (placeholder)
+        self._holders = []        # layers created by static.nn.* (param owners)
+        self.random_seed = 0
+
+    # ------------------------------------------------------------------ #
+
+    def _record(self, name, fn, inputs, result):
+        entries = []
+        for i in inputs:
+            if isinstance(i, Tensor):
+                entries.append(("t", id(i), i))
+            else:
+                entries.append(("c", np.asarray(i), None))
+        outs = result if isinstance(result, (list, tuple)) else [result]
+        out_ids = [id(o) for o in outs if isinstance(o, Tensor)]
+        # keep the output objects alive so ids stay unique for the program
+        self._ops.append((name, fn, entries, out_ids,
+                          [o for o in outs if isinstance(o, Tensor)]))
+
+    def global_block(self):
+        return self
+
+    @property
+    def blocks(self):
+        return [self]
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._ops = list(self._ops)
+        p._placeholders = dict(self._placeholders)
+        p._holders = list(self._holders)
+        return p
+
+    def all_parameters(self):
+        params = []
+        for h in self._holders:
+            params.extend(p for _, p in h.named_parameters())
+        return params
+
+    # ------------------------------------------------------------------ #
+
+    def _build_replay(self, fetch_ids):
+        """A pure function feeds_dict -> fetches replaying the trace."""
+        placeholders = {id(t): name for name, t in self._placeholders.items()}
+        ops = self._ops
+
+        def replay(feeds, live_params):
+            env = {}
+            for pid, fname in placeholders.items():
+                env[pid] = feeds[fname]
+            env.update(live_params)
+
+            from ..framework.core import tracing_guard
+
+            with tracing_guard(True):
+                for name, fn, entries, out_ids, _outs in ops:
+                    args = []
+                    for kind, a, obj in entries:
+                        if kind == "c":
+                            args.append(a)
+                        else:
+                            v = env.get(a)
+                            if v is None:
+                                # external tensor captured at trace time
+                                v = obj._value
+                            args.append(v)
+                    res = fn(*args)
+                    res_list = res if isinstance(res, tuple) else [res]
+                    for oid, val in zip(out_ids, res_list):
+                        env[oid] = val
+            return [env[fid] for fid in fetch_ids]
+
+        return replay
+
+    def _live_param_map(self):
+        out = {}
+        for h in self._holders:
+            for _, p in h.named_parameters():
+                out[id(p)] = p._value
+        return out
+
+
+_default_main = Program()
+_default_startup = Program()
+_current = [_default_main]
+
+
+def default_main_program():
+    """reference framework.py default_main_program."""
+    return _default_main
+
+
+def default_startup_program():
+    """reference framework.py default_startup_program — parameter init runs
+    eagerly at layer construction here, so the startup program is an empty
+    trace kept for API parity."""
+    return _default_startup
+
+
+_static_mode = [False]
+
+
+def enable_static():
+    """reference paddle.enable_static — bare static building (no
+    program_guard) records into the default main program."""
+    _static_mode[0] = True
+    _core.set_op_recorder(_current[-1]._record)
+
+
+def disable_static():
+    _static_mode[0] = False
+    if len(_current) == 1:
+        _core.set_op_recorder(None)
+
+
+def in_static_mode():
+    return _static_mode[0] or len(_current) > 1
+
+
+class program_guard:
+    """reference framework.py:7480 — routes op recording into `main`."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _current.append(self.main)
+        _core.set_op_recorder(self.main._record)
+        return self
+
+    def __exit__(self, *exc):
+        _current.pop()
+        if len(_current) > 1 or _static_mode[0]:
+            _core.set_op_recorder(_current[-1]._record)
+        else:
+            _core.set_op_recorder(None)
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference python/paddle/static/input.py data).
+    None/-1 dims capture as 1; replay binds the real fed shape."""
+    shp = tuple(1 if (s is None or int(s) < 0) else int(s) for s in shape)
+    t = Tensor(jnp.zeros(shp, convert_dtype(dtype)))
+    t.name = name
+    prog = _current[-1]
+    prog._placeholders[name] = t
+    return t
+
+
+from ..jit import InputSpec  # noqa: E402  (one spec type, shared with jit)
+
+
+class Executor:
+    """reference executor.py:1256 — run() jits the recorded trace."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        program = program or _default_main
+        if program is _default_startup or not program._ops:
+            return []  # startup: params already initialized eagerly
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_ids = [id(f) for f in fetch_list]
+
+        missing = [n for n in program._placeholders if n not in feed]
+        if missing:
+            # silent zeros would be plausible-looking garbage; the reference
+            # raises on an unfed placeholder (executor.py feed check)
+            raise ValueError(
+                f"Executor.run: missing feed for placeholder(s) {missing}; "
+                f"got feed keys {sorted(feed)}")
+        feeds = {}
+        for name in program._placeholders:
+            v = feed[name]
+            feeds[name] = jnp.asarray(
+                v._value if isinstance(v, Tensor) else np.asarray(v))
+
+        key = (id(program), tuple(fetch_ids))
+        entry = self._cache.get(key)
+        if entry is None:
+            replay = program._build_replay(fetch_ids)
+            entry = jax.jit(replay)
+            self._cache[key] = entry
+        outs = entry(feeds, program._live_param_map())
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        self._cache.clear()
+
+
+class CompiledProgram:
+    """reference compiler.py CompiledProgram — jit is the compiler; kept as
+    a transparent wrapper for API parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_program"], item)
+
+
+# --------------------------------------------------------------------------- #
+# scope / places (API parity)
+# --------------------------------------------------------------------------- #
+
+
+class _Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, Tensor(jnp.zeros(())))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def cpu_places(device_count=None):
+    from .. import CPUPlace
+
+    return [CPUPlace()] * (device_count or 1)
+
+
+def cuda_places(device_ids=None):
+    from .. import TPUPlace
+
+    return [TPUPlace()]
+
+
+# --------------------------------------------------------------------------- #
+# static.nn (reference python/paddle/static/nn/common.py)
+# --------------------------------------------------------------------------- #
+
+
+class _StaticNN:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+           **kwargs):
+        """reference static/nn/common.py fc — creates the layer's parameters
+        in the current program and applies it."""
+        import paddle_tpu.nn as pnn
+
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = pnn.Linear(in_features, size)
+        prog = _current[-1]
+        prog._holders.append(layer)
+        h = x
+        if len(x.shape) > num_flatten_dims + 1:
+            h = h.reshape(tuple(x.shape[:num_flatten_dims]) + (-1,))
+        out = layer(h)
+        if activation:
+            import paddle_tpu.nn.functional as F
+
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(x, **kwargs):
+        import paddle_tpu.nn as pnn
+
+        layer = pnn.BatchNorm(int(x.shape[1]))
+        _current[-1]._holders.append(layer)
+        return layer(x)
+
+
+nn = _StaticNN()

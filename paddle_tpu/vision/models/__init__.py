@@ -1,4 +1,14 @@
 from .lenet import LeNet
+from .mobilenet import (
+    MobileNetV1,
+    MobileNetV2,
+    MobileNetV3Large,
+    MobileNetV3Small,
+    mobilenet_v1,
+    mobilenet_v2,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
 from .resnet import (
     ResNet,
     resnet18,
@@ -11,9 +21,13 @@ from .resnet import (
     wide_resnet50_2,
     wide_resnet101_2,
 )
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 
 __all__ = [
     "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
     "resnet152", "resnext50_32x4d", "resnext101_32x8d", "wide_resnet50_2",
     "wide_resnet101_2",
+    "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small", "mobilenet_v3_large",
 ]
