@@ -119,8 +119,24 @@ void handle_conn(Server* s, int fd) {
         s->kv.erase(key);
       }
       if (!send_blob(fd, "")) break;
+    } else if (op == 6) {  // TRYGET (non-blocking; missing -> 0x00 marker)
+      std::string out;
+      bool found;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        auto it = s->kv.find(key);
+        found = it != s->kv.end();
+        if (found) out = it->second;
+      }
+      // prefix byte distinguishes "missing" from "present but empty"
+      if (!send_blob(fd, (found ? std::string("\x01", 1) : std::string("\x00", 1)) + out)) break;
     } else {
-      break;
+      // Unknown op (newer client against this server): reply with an error
+      // marker instead of dropping the connection, so one unsupported call
+      // does not poison the client's cached fd for every later op. The
+      // reverse skew (new client, OLD server binary) still drops — rebuild
+      // all hosts from the same tree.
+      if (!send_blob(fd, std::string("\xff", 1) + "ERR:unknown-op")) break;
     }
   }
   {
@@ -283,6 +299,17 @@ int tcp_store_add(intptr_t fd, const char* key, long long delta,
 int tcp_store_wait(intptr_t fd, const char* key) {
   std::string out;
   return request(static_cast<int>(fd), 4, key, nullptr, 0, &out);
+}
+
+// Non-blocking probe: returns the value length (copied into buf up to cap)
+// when present, -2 when the key is missing, -1 on transport failure.
+long tcp_store_tryget(intptr_t fd, const char* key, void* buf, long cap) {
+  std::string out;
+  if (request(static_cast<int>(fd), 6, key, nullptr, 0, &out) != 0) return -1;
+  if (out.empty() || out[0] == '\0') return -2;
+  long n = static_cast<long>(out.size()) - 1;
+  memcpy(buf, out.data() + 1, std::min<long>(n, cap));
+  return n;
 }
 
 int tcp_store_delete(intptr_t fd, const char* key) {

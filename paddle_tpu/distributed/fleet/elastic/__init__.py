@@ -1,0 +1,3 @@
+from .manager import ElasticManager, ElasticStatus
+
+__all__ = ["ElasticManager", "ElasticStatus"]

@@ -1,0 +1,131 @@
+"""Elastic fault detection / recovery (reference:
+python/paddle/distributed/fleet/elastic/manager.py — ElasticManager :125,
+LauncherInterface watchdog, np range N:M scaling).
+
+TPU formulation: the rendezvous substrate is the native TCPStore
+(native/tcp_store.cc) instead of etcd. Each node heartbeats
+`<job>/heartbeat/<rank>` with a timestamp; the manager watches the live set
+against the `np` range — a missing heartbeat marks the node dead, shrinking
+below min-nodes makes the job NOT-ready (the launcher tears down and
+restarts the pod, launch/main.py restart loop), and rejoin within the range
+resumes. Host failure detection on a TPU pod is exactly this liveness
+protocol; chip failure surfaces as a jax.distributed error that kills the
+worker, which the same loop catches."""
+
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"  # training finished (complete() was called)
+    ERROR = "error"
+    HOLD = "hold"            # below min nodes: wait for rejoin
+    RESTART = "restart"      # live set can still grow / changed: re-form
+    EXIT = "exit"
+    OK = "ok"                # healthy full cluster, no action (TPU extension)
+
+
+class ElasticManager:
+    """reference elastic/manager.py:125."""
+
+    def __init__(self, store=None, job_id=None, np_range=None, rank=None,
+                 heartbeat_interval=2.0, timeout=10.0):
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        np_spec = np_range or os.environ.get("PADDLE_ELASTIC_NP", "1")
+        if isinstance(np_spec, str) and ":" in np_spec:
+            lo, hi = np_spec.split(":")
+            self.min_np, self.max_np = int(lo), int(hi)
+        else:
+            self.min_np = self.max_np = int(np_spec)
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.heartbeat_interval = heartbeat_interval
+        self.timeout = timeout
+        if store is None:
+            from ...store import create_or_get_global_tcp_store
+
+            store = create_or_get_global_tcp_store()
+        self.store = store
+        self.enable = self.max_np > 1 or self.min_np != self.max_np
+        self._stopped = False
+
+    # ------------------------------------------------------------------ #
+
+    def _key(self, rank):
+        return f"{self.job_id}/heartbeat/{rank}"
+
+    def heartbeat(self):
+        """Publish this node's liveness (reference: etcd lease refresh)."""
+        self.store.set(self._key(self.rank), str(time.time()).encode())
+
+    def alive_nodes(self):
+        """Ranks whose heartbeat is fresher than the timeout."""
+        now = time.time()
+        alive = []
+        probe = getattr(self.store, "tryget", None)
+        if probe is None:
+            # a blocking get() on a dead rank's key would stall the scan for
+            # the full io timeout — exactly what this probe exists to avoid
+            raise TypeError(
+                "ElasticManager requires a store with a non-blocking "
+                "tryget() (native TCPStore)")
+        for r in range(self.max_np):
+            try:
+                raw = probe(self._key(r))
+            except Exception:
+                continue
+            if not raw:
+                continue
+            try:
+                ts = float(raw.decode())
+            except ValueError:
+                continue
+            if now - ts <= self.timeout:
+                alive.append(r)
+        return alive
+
+    def is_ready(self):
+        """Job can (re)start: live nodes within [min_np, max_np]."""
+        return len(self.alive_nodes()) >= self.min_np
+
+    def complete(self):
+        """Mark the job finished (reference: trainers reporting completion
+        before the manager exits the watch loop)."""
+        self.store.set(f"{self.job_id}/completed", b"1")
+
+    def is_completed(self):
+        probe = getattr(self.store, "tryget", None)
+        try:
+            return bool(probe and probe(f"{self.job_id}/completed"))
+        except Exception:
+            return False
+
+    def watch(self):
+        """One scheduling decision (reference manager.watch loop):
+        COMPLETED when training reported done, HOLD below min (wait for
+        rejoin), RESTART while the live set can still change, OK for a
+        healthy full cluster."""
+        if self.is_completed():
+            return ElasticStatus.COMPLETED
+        alive = self.alive_nodes()
+        if len(alive) < self.min_np:
+            return ElasticStatus.HOLD
+        if len(alive) < self.max_np:
+            return ElasticStatus.RESTART
+        return ElasticStatus.OK
+
+    def exit(self, completed=True):
+        self._stopped = True
+        if completed:
+            try:
+                self.complete()
+            except Exception:
+                pass
+        try:
+            self.store.delete_key(self._key(self.rank))
+        except Exception:
+            pass

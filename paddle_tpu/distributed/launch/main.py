@@ -1,82 +1,230 @@
-"""Launcher implementation (reference: python/paddle/distributed/launch/main.py)."""
+"""Launcher (reference: python/paddle/distributed/launch/main.py CLI +
+controllers/collective.py:22-150 CollectiveController/Pod + watcher.py log
+watcher + fleet/elastic/manager.py restart semantics).
+
+One controller process per host (TPU model: the process drives all local
+chips through PJRT; jax.distributed handles multi-host rendezvous). The
+controller spawns the worker pod, a watcher thread tails worker logs for
+fatal patterns and monitors liveness, and on worker failure the pod is torn
+down and — when --max_restart allows — respawned with PADDLE_RESTART_COUNT
+incremented (elastic level 1: in-place pod restart; the reference's etcd
+scale-in/out is the same loop keyed on a store watch)."""
 
 from __future__ import annotations
 
 import argparse
 import os
+import re
 import signal
 import subprocess
 import sys
+import threading
 import time
 
-__all__ = ["launch"]
+__all__ = ["launch", "Pod", "LogWatcher"]
+
+_FATAL_PATTERNS = re.compile(
+    r"(FatalError|Check failed|core dumped|Segmentation fault|NumericError)")
 
 
-def _parse():
-    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
-    p.add_argument("--master", default=None, help="coordinator ip:port (rank-0 host)")
-    p.add_argument("--nnodes", default="1", help="number of hosts (N or N:M)")
-    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
-    p.add_argument("--nproc_per_node", type=int, default=1,
-                   help="controller processes per host (TPU: 1)")
-    p.add_argument("--log_dir", default="log")
-    p.add_argument("--run_mode", default="collective")
-    p.add_argument("--job_id", default="default")
-    p.add_argument("--devices", default=None, help="accepted for parity; TPU devices are auto-discovered")
-    p.add_argument("training_script")
-    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args()
+class LogWatcher(threading.Thread):
+    """Tails worker log files, surfacing fatal patterns (reference:
+    launch/controllers/watcher.py)."""
+
+    def __init__(self, paths, on_fatal=None, interval=0.5):
+        super().__init__(daemon=True)
+        self.paths = list(paths)
+        self.on_fatal = on_fatal
+        self.interval = interval
+        self.fatal_lines: list[str] = []
+        # start at the current size: logs open in append mode, and a stale
+        # fatal line from a previous launcher run must not kill a fresh pod
+        self._offsets = {}
+        for p in self.paths:
+            try:
+                self._offsets[p] = os.path.getsize(p)
+            except OSError:
+                self._offsets[p] = 0
+        self._stop_evt = threading.Event()  # NB: Thread reserves _stop
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def scan_once(self):
+        for p in self.paths:
+            try:
+                with open(p, "rb") as f:
+                    f.seek(self._offsets[p])
+                    chunk = f.read()
+            except OSError:
+                continue
+            # only consume complete lines — a fatal pattern split across a
+            # read boundary must still match on the next scan
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                continue
+            self._offsets[p] += cut + 1
+            for line in chunk[:cut].decode(errors="replace").splitlines():
+                if _FATAL_PATTERNS.search(line):
+                    self.fatal_lines.append(f"{p}: {line}")
+                    if self.on_fatal is not None:
+                        self.on_fatal(p, line)
+
+    def run(self):
+        while not self._stop_evt.is_set():
+            self.scan_once()
+            time.sleep(self.interval)
+        self.scan_once()
 
 
-def launch():
-    args = _parse()
-    nnodes = int(str(args.nnodes).split(":")[0])
-    os.makedirs(args.log_dir, exist_ok=True)
+class Pod:
+    """The set of worker processes on this host (reference Pod in
+    launch/controllers/collective.py)."""
 
-    procs = []
-    for local in range(args.nproc_per_node):
-        env = dict(os.environ)
-        env["PADDLE_TRAINER_ID"] = str(args.rank * args.nproc_per_node + local)
-        env["PADDLE_TRAINERS_NUM"] = str(nnodes * args.nproc_per_node)
-        env["PADDLE_LOCAL_RANK"] = str(local)
-        env["PADDLE_JOB_ID"] = args.job_id
-        if args.master:
-            env["PADDLE_MASTER"] = args.master
-            env["JAX_COORDINATOR_ADDRESS"] = args.master
-        log_path = os.path.join(args.log_dir, f"workerlog.{local}")
-        with open(log_path, "ab") as logf:
+    def __init__(self, args, restart_count=0):
+        self.args = args
+        self.restart_count = restart_count
+        self.procs: list[subprocess.Popen] = []
+        self.log_paths: list[str] = []
+
+    def spawn(self):
+        args = self.args
+        nnodes = int(str(args.nnodes).split(":")[0])
+        os.makedirs(args.log_dir, exist_ok=True)
+        for local in range(args.nproc_per_node):
+            env = dict(os.environ)
+            env["PADDLE_TRAINER_ID"] = str(
+                args.rank * args.nproc_per_node + local)
+            env["PADDLE_TRAINERS_NUM"] = str(nnodes * args.nproc_per_node)
+            env["PADDLE_LOCAL_RANK"] = str(local)
+            env["PADDLE_JOB_ID"] = args.job_id
+            env["PADDLE_RESTART_COUNT"] = str(self.restart_count)
+            if args.master:
+                env["PADDLE_MASTER"] = args.master
+                env["JAX_COORDINATOR_ADDRESS"] = args.master
+            log_path = os.path.join(
+                args.log_dir, f"workerlog.{local}.r{self.restart_count}")
+            self.log_paths.append(log_path)
+            logf = open(log_path, "ab")
             proc = subprocess.Popen(
-                [sys.executable, args.training_script, *args.training_script_args],
-                env=env, stdout=logf if args.nproc_per_node > 1 else None,
-                stderr=subprocess.STDOUT if args.nproc_per_node > 1 else None,
+                [sys.executable, args.training_script,
+                 *args.training_script_args],
+                env=env, stdout=logf, stderr=subprocess.STDOUT,
             )
-        procs.append(proc)
+            proc._logf = logf  # closed in terminate()/watch()
+            self.procs.append(proc)
+            print(f"[launch] worker {local} (restart {self.restart_count}) "
+                  f"logging to {log_path}", file=sys.stderr)
 
-    def _terminate(signum, frame):
-        for p in procs:
-            p.terminate()
-        sys.exit(1)
+    def terminate(self, grace=3.0):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + grace
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+            try:  # reap: the restart loop keeps this process alive, so an
+                p.wait(timeout=5)  # unreaped child would linger as a zombie
+            except Exception:
+                pass
+        self._close_logs()
 
-    signal.signal(signal.SIGTERM, _terminate)
-    signal.signal(signal.SIGINT, _terminate)
+    def _close_logs(self):
+        for p in self.procs:
+            f = getattr(p, "_logf", None)
+            if f is not None and not f.closed:
+                f.close()
 
-    exit_code = 0
-    try:
+    def watch(self, fatal_evt=None):
+        """Block until the pod finishes, a worker fails, or the log watcher
+        flags a fatal line (covers workers that log the error but HANG in a
+        collective instead of exiting — the failure mode the reference
+        watcher exists for); returns the pod exit code (first nonzero
+        worker code, 1 on fatal-log teardown, 0 when all succeed)."""
+        procs = list(self.procs)
         while procs:
+            if fatal_evt is not None and fatal_evt.is_set():
+                self.terminate()
+                return 1
             for p in list(procs):
                 ret = p.poll()
                 if ret is None:
                     continue
                 procs.remove(p)
                 if ret != 0:
-                    # a failed trainer kills the pod (reference watcher behavior)
-                    exit_code = ret
-                    for q in procs:
-                        q.terminate()
-                    procs.clear()
-                    break
-            time.sleep(0.5)
-    finally:
-        for p in procs:
-            p.terminate()
-    sys.exit(exit_code)
+                    self.terminate()
+                    return ret
+            time.sleep(0.3)
+        self._close_logs()
+        return 0
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None, help="coordinator ip:port (rank-0 host)")
+    p.add_argument("--nnodes", default="1",
+                   help="number of hosts, N or N:M (elastic range)")
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="controller processes per host (TPU: 1)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restart", type=int,
+                   default=int(os.environ.get("PADDLE_MAX_RESTART", "0")),
+                   help="elastic: respawn the pod up to N times on failure")
+    p.add_argument("--elastic_level", type=int, default=None,
+                   help="-1/0 off, 1 in-place pod restart (implies "
+                        "max_restart>=1 when set)")
+    p.add_argument("--devices", default=None, help="accepted for parity; TPU devices are auto-discovered")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    # N:M elastic range implies restartability (reference --nnodes=2:4)
+    if ":" in str(args.nnodes) and args.max_restart == 0:
+        args.max_restart = 3
+    return args
+
+
+def launch():
+    args = _parse()
+    if args.elastic_level and args.elastic_level > 0 and args.max_restart == 0:
+        args.max_restart = 3  # reference elastic default
+
+    restart = 0
+    current: list[Pod] = []
+
+    def _terminate(signum, frame):
+        for pod in current:
+            pod.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
+    while True:
+        pod = Pod(args, restart_count=restart)
+        current[:] = [pod]
+        pod.spawn()
+        fatal_evt = threading.Event()
+        watcher = LogWatcher(pod.log_paths,
+                             on_fatal=lambda p, line: fatal_evt.set())
+        watcher.start()
+        code = pod.watch(fatal_evt)
+        watcher.stop()
+        watcher.join(timeout=5)
+        for line in watcher.fatal_lines:
+            print(f"[launch] fatal log: {line}", file=sys.stderr)
+        if code == 0:
+            sys.exit(0)
+        if restart >= args.max_restart:
+            print(f"[launch] pod failed (exit {code}), restarts exhausted "
+                  f"({restart}/{args.max_restart})", file=sys.stderr)
+            sys.exit(code)
+        restart += 1
+        print(f"[launch] pod failed (exit {code}); restart "
+              f"{restart}/{args.max_restart}", file=sys.stderr)
+        time.sleep(1.0)

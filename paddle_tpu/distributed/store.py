@@ -78,6 +78,32 @@ class TCPStore:
             raise RuntimeError("TCPStore.get failed")
         return buf.raw[:n]
 
+    def tryget(self, key: str):
+        """Non-blocking probe: value bytes, or None when the key is absent
+        (used by the elastic liveness watcher — a blocking GET on a dead
+        node's heartbeat would stall the whole watch loop)."""
+        import ctypes
+
+        if not hasattr(self._lib, "tcp_store_tryget"):
+            raise RuntimeError(
+                "native library predates tcp_store_tryget — rebuild with "
+                "`make -C native`")
+        cap = 1 << 20
+        with self._lock:
+            for _ in range(8):
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.tcp_store_tryget(self._fd, key.encode(), buf, cap)
+                if n <= cap:
+                    break
+                cap = int(n)
+            else:
+                raise RuntimeError("TCPStore.tryget: value kept outgrowing buffer")
+        if n == -2:
+            return None
+        if n < 0:
+            raise RuntimeError("TCPStore.tryget failed")
+        return buf.raw[:n]
+
     def add(self, key: str, amount: int) -> int:
         import ctypes
 

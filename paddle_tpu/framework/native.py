@@ -97,6 +97,10 @@ def load():
         lib.tcp_store_get.restype = ctypes.c_long
         lib.tcp_store_get.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
                                       ctypes.c_char_p, ctypes.c_long]
+        if hasattr(lib, "tcp_store_tryget"):  # absent in pre-existing builds
+            lib.tcp_store_tryget.restype = ctypes.c_long
+            lib.tcp_store_tryget.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
+                                             ctypes.c_char_p, ctypes.c_long]
         lib.tcp_store_add.restype = ctypes.c_int
         lib.tcp_store_add.argtypes = [ctypes.c_ssize_t, ctypes.c_char_p,
                                       ctypes.c_longlong,

@@ -1,0 +1,130 @@
+"""Launcher restart + elastic manager (reference:
+python/paddle/distributed/launch/controllers/collective.py:22-150,
+launch/controllers/watcher.py, fleet/elastic/manager.py:125)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, extra_args, script_body):
+    script = os.path.join(tmp_path, "train.py")
+    with open(script, "w") as f:
+        f.write(script_body)
+    env = {
+        "PYTHONPATH": REPO,
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": "cpu",
+        "SENTINEL": os.path.join(tmp_path, "sentinel"),
+    }
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         f"--log_dir={tmp_path}/log", *extra_args, script],
+        env=env, capture_output=True, text=True, timeout=240, cwd=tmp_path)
+    return proc
+
+
+CRASH_ONCE = """
+import os, sys
+s = os.environ["SENTINEL"]
+if not os.path.exists(s):
+    open(s, "w").write("x")
+    print("FatalError: injected first-run crash", flush=True)
+    sys.exit(17)
+print("restart_count=", os.environ.get("PADDLE_RESTART_COUNT"), flush=True)
+print("OK", flush=True)
+"""
+
+
+def test_launcher_restarts_failed_pod(tmp_path):
+    """Kill-one-child-and-observe-restart (VERDICT done-criterion): the
+    first run exits 17; with --max_restart the pod respawns and succeeds."""
+    proc = _run_launch(tmp_path, ["--max_restart=2"], CRASH_ONCE)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restart 1/2" in proc.stderr
+    assert "fatal log" in proc.stderr  # LogWatcher surfaced the error line
+    logs = os.listdir(os.path.join(tmp_path, "log"))
+    assert any(l.endswith(".r0") for l in logs)
+    assert any(l.endswith(".r1") for l in logs)
+    r1 = [l for l in logs if l.endswith(".r1")][0]
+    out = open(os.path.join(tmp_path, "log", r1)).read()
+    assert "restart_count= 1" in out and "OK" in out
+
+
+def test_launcher_exhausts_restarts(tmp_path):
+    proc = _run_launch(tmp_path, ["--max_restart=1"], """
+import sys
+sys.exit(9)
+""")
+    assert proc.returncode == 9
+    assert "restarts exhausted" in proc.stderr
+
+
+def test_launcher_no_restart_by_default(tmp_path):
+    proc = _run_launch(tmp_path, [], """
+import sys
+sys.exit(5)
+""")
+    assert proc.returncode == 5
+    assert "restart 1" not in proc.stderr
+
+
+def test_nnodes_range_implies_restart(tmp_path):
+    proc = _run_launch(tmp_path, ["--nnodes=1:2"], CRASH_ONCE)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restart 1/3" in proc.stderr
+
+
+def test_fatal_log_tears_down_hung_worker(tmp_path):
+    """A worker that logs a fatal line but HANGS (the classic stuck-
+    collective failure) must be torn down by the log watcher, not waited on
+    forever (reference launch/controllers/watcher.py)."""
+    t0 = time.time()
+    proc = _run_launch(tmp_path, [], """
+import time
+print("FatalError: poisoned collective", flush=True)
+time.sleep(120)
+""")
+    assert proc.returncode != 0
+    assert time.time() - t0 < 60, "watcher did not tear down the hung worker"
+    assert "fatal log" in proc.stderr
+
+
+def test_elastic_manager_liveness():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+    from paddle_tpu.distributed.store import TCPStore
+
+    srv = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        m0 = ElasticManager(store=srv, job_id="j", np_range="1:2", rank=0,
+                            timeout=1.5)
+        assert m0.enable
+        # nothing has heartbeat yet -> below min -> HOLD
+        assert m0.alive_nodes() == []
+        assert m0.watch() == ElasticStatus.HOLD
+        m0.heartbeat()
+        assert m0.alive_nodes() == [0]
+        assert m0.is_ready()
+        # one node in a 1:2 range -> can still scale up -> RESTART signal
+        assert m0.watch() == ElasticStatus.RESTART
+        m1 = ElasticManager(store=srv, job_id="j", np_range="1:2", rank=1,
+                            timeout=1.5)
+        m1.heartbeat()
+        assert sorted(m0.alive_nodes()) == [0, 1]
+        assert m0.watch() == ElasticStatus.OK  # healthy full cluster
+        # rank-1 death: heartbeat ages out -> back to RESTART
+        time.sleep(1.6)
+        m0.heartbeat()
+        assert m0.alive_nodes() == [0]
+        assert m0.watch() == ElasticStatus.RESTART
+        m0.exit(completed=True)
+        assert m0.alive_nodes() == []
+        assert m0.watch() == ElasticStatus.COMPLETED
+    finally:
+        srv.close()
