@@ -263,6 +263,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     every rank's tensor. Global-array view: slices of the stacked array;
     multi-controller: one compiled all-gather over the processes."""
     g = _grp(group)
+    if g.nranks == 1:
+        tensor_list.append(Tensor(tensor._value))
+        return _Task()
     mp = _mp_active(g)
     if mp is not None:
         import jax.numpy as jnp
@@ -282,6 +285,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 def all_gather_object(object_list, obj, group=None):
     g = _grp(group)
+    if g.nranks == 1:
+        object_list.append(obj)
+        return _Task()
     mp = _mp_active(g)
     if mp is not None:
         object_list.extend(mp.allgather_objects(obj))
@@ -298,6 +304,9 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
 
     g = _grp(group)
     vals = [t._value if isinstance(t, Tensor) else jnp.asarray(t) for t in tensor_list]
+    if g.nranks == 1:
+        tensor._value = vals[0]
+        return _Task(tensor)
     mp = _mp_active(g)
     if mp is not None:
         # rank r's output = reduction over processes of their tensor_list[r]
@@ -348,6 +357,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     import jax.numpy as jnp
 
     g = _grp(group)
+    if g.nranks == 1:
+        if tensor_list:
+            tensor._value = tensor_list[0]._value
+        return _Task(tensor)
     mp = _mp_active(g)
     if mp is not None:
         payload = ([np.asarray(t._value) for t in tensor_list]
@@ -364,6 +377,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
     g = _grp(group)
+    if g.nranks == 1:
+        if in_object_list:
+            out_object_list.append(in_object_list[0])
+        return _Task()
     mp = _mp_active(g)
     if mp is not None:
         payload = in_object_list if mp.rank() == src else None
@@ -382,6 +399,10 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = _grp(group)
     n = g.nranks
     vals = [t._value for t in in_tensor_list]
+    if n == 1:
+        for v in vals:
+            out_tensor_list.append(Tensor(v))
+        return _Task()
     mp = _mp_active(g)
     if mp is not None:
         rows = mp.allgather_values(np.stack([np.asarray(v) for v in vals]))
@@ -416,6 +437,9 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
             "unequal split sizes are not supported by the eager "
             "alltoall_single; use equal chunks or the compiled primitives"
         )
+    if n == 1:
+        out_tensor._value = v
+        return _Task(out_tensor)
     mp = _mp_active(g)
     if mp is not None:
         out_tensor._value = jnp.asarray(
@@ -518,6 +542,8 @@ def barrier(group=None):
     import jax
 
     g = _grp(group)
+    if g.nranks == 1:
+        return _Task()
     mp = _mp_active(g)
     if mp is not None:
         mp.barrier()

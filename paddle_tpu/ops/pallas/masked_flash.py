@@ -126,8 +126,10 @@ def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse = jnp.where(l[:, 0] == 0.0, NEG_INF, m_scr[:, 0] + jnp.log(l_safe[:, 0]))
-        lse_ref[0, 0] = lse
+        # [bq, 1] layout (trailing singleton keeps Mosaic tiling legal,
+        # see flash_attention.py _fwd_kernel)
+        lse_ref[0, 0] = jnp.where(l == 0.0, NEG_INF,
+                                  m_scr[:, :1] + jnp.log(l_safe))
 
 
 def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
@@ -154,8 +156,8 @@ def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]  # [bq, 1]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -198,8 +200,8 @@ def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]  # [bq, 1]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -248,11 +250,11 @@ def _fm_fwd(q, k, v, idx, scale, causal, sq, skv):
         in_specs=_fm_specs(B, H, Hm, Hkv, n, bq, bk, D),
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sqp, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sqp), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sqp, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -272,7 +274,8 @@ def _fm_bwd(scale, causal, sq, skv, residuals, dout):
     nq, nk = Sqp // bq, Skvp // bk
     group = H // Hkv
 
-    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B, H, Sqp, 1] like lse
     io_specs = _fm_specs(B, H, Hm, Hkv, n, bq, bk, D)
 
     dq = pl.pallas_call(
@@ -281,8 +284,8 @@ def _fm_bwd(scale, causal, sq, skv, residuals, dout):
         grid=(B, H, nq, nk),
         in_specs=io_specs + [
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sqp, D), q.dtype),
@@ -296,8 +299,8 @@ def _fm_bwd(scale, causal, sq, skv, residuals, dout):
         pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i, g=group: (b, h // g, j, 0)),
         pl.BlockSpec((1, 1, n, bk), lambda b, h, j, i, g=H // Hm: (b, h // g, 0, j)),
         pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
-        pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_fm_bwd_dkv_kernel, scale=scale, causal=causal, n=n,
@@ -382,12 +385,15 @@ def flashmask_attention_fwd(q, k, v, startend_row_indices, causal=True,
 
 def _vl_keep(sq_blk, sk_blk, pq_blk, pk_blk, causal, tq, tk, q_start, k_start,
              bq, bk):
+    """sq/pq ride as [bq, 1] columns, sk/pk as [1, bk] rows (2-D layouts —
+    1-D s32 operands trip the XLA-vs-Mosaic tiling mismatch on real TPUs);
+    plain broadcasting then forms the [bq, bk] mask."""
     row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     col = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     keep = (row < tq) & (col < tk)
-    keep = keep & (sq_blk[:, None] == sk_blk[None, :])
+    keep = keep & (sq_blk == sk_blk)
     if causal:
-        keep = keep & (pq_blk[:, None] >= pk_blk[None, :])
+        keep = keep & (pq_blk >= pk_blk)
     return keep
 
 
@@ -405,8 +411,8 @@ def _vl_fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    keep = _vl_keep(sq_ref[:].astype(jnp.int32), sk_ref[:].astype(jnp.int32),
-                    pq_ref[:].astype(jnp.int32), pk_ref[:].astype(jnp.int32),
+    keep = _vl_keep(sq_ref[...].astype(jnp.int32), sk_ref[...].astype(jnp.int32),
+                    pq_ref[...].astype(jnp.int32), pk_ref[...].astype(jnp.int32),
                     causal, tq, tk, q_start, k_start, bq, bk)
 
     @pl.when(jnp.any(keep))
@@ -435,8 +441,8 @@ def _vl_fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(l[:, 0] == 0.0, NEG_INF,
-                               m_scr[:, 0] + jnp.log(l_safe[:, 0]))
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF,
+                               m_scr[:, :1] + jnp.log(l_safe))
 
 
 def _vl_bwd_dq_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
@@ -449,8 +455,8 @@ def _vl_bwd_dq_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    keep = _vl_keep(sq_ref[:].astype(jnp.int32), sk_ref[:].astype(jnp.int32),
-                    pq_ref[:].astype(jnp.int32), pk_ref[:].astype(jnp.int32),
+    keep = _vl_keep(sq_ref[...].astype(jnp.int32), sk_ref[...].astype(jnp.int32),
+                    pq_ref[...].astype(jnp.int32), pk_ref[...].astype(jnp.int32),
                     causal, tq, tk, i * bq, j * bk, bq, bk)
 
     @pl.when(jnp.any(keep))
@@ -459,8 +465,8 @@ def _vl_bwd_dq_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]  # [bq, 1]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -490,8 +496,8 @@ def _vl_bwd_dkv_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    keep = _vl_keep(sq_ref[:].astype(jnp.int32), sk_ref[:].astype(jnp.int32),
-                    pq_ref[:].astype(jnp.int32), pk_ref[:].astype(jnp.int32),
+    keep = _vl_keep(sq_ref[...].astype(jnp.int32), sk_ref[...].astype(jnp.int32),
+                    pq_ref[...].astype(jnp.int32), pk_ref[...].astype(jnp.int32),
                     causal, tq, tk, i * bq, j * bk, bq, bk)
 
     @pl.when(jnp.any(keep))
@@ -500,8 +506,8 @@ def _vl_bwd_dkv_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, pq_ref, pk_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]  # [bq, 1]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -541,21 +547,21 @@ def _vl_specs(bq, bk, D, group, transpose_grid=False):
     if transpose_grid:  # grid (H, nk, nq)
         qm = lambda h, j, i: (h, i, 0)
         km = lambda h, j, i, g=group: (h // g, j, 0)
-        sqm = lambda h, j, i: (i,)
-        skm = lambda h, j, i: (j,)
+        sqm = lambda h, j, i: (i, 0)
+        skm = lambda h, j, i: (0, j)
     else:  # grid (H, nq, nk)
         qm = lambda h, i, j: (h, i, 0)
         km = lambda h, i, j, g=group: (h // g, j, 0)
-        sqm = lambda h, i, j: (i,)
-        skm = lambda h, i, j: (j,)
+        sqm = lambda h, i, j: (i, 0)
+        skm = lambda h, i, j: (0, j)
     return [
         pl.BlockSpec((1, bq, D), qm),
         pl.BlockSpec((1, bk, D), km),
         pl.BlockSpec((1, bk, D), km),
-        pl.BlockSpec((bq,), sqm),
-        pl.BlockSpec((bk,), skm),
-        pl.BlockSpec((bq,), sqm),
-        pl.BlockSpec((bk,), skm),
+        pl.BlockSpec((bq, 1), sqm),
+        pl.BlockSpec((1, bk), skm),
+        pl.BlockSpec((bq, 1), sqm),
+        pl.BlockSpec((1, bk), skm),
     ]
 
 
@@ -572,11 +578,11 @@ def _vl_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, scale, causal, tq, tk):
         in_specs=_vl_specs(bq, bk, D, group),
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, bq), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((H, Tqp, D), q.dtype),
-            jax.ShapeDtypeStruct((H, Tqp), jnp.float32),
+            jax.ShapeDtypeStruct((H, Tqp, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -599,11 +605,12 @@ def _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale):
     qp = _pad_tokens(q, bq)
     kp = _pad_tokens(k, bk)
     vp = _pad_tokens(v, bk)
-    # pad segments with distinct sentinels so padding never matches
-    sqp = _pad_vec(seg_q.astype(jnp.int32), bq, -1)
-    skp = _pad_vec(seg_k.astype(jnp.int32), bk, -2)
-    pqp = _pad_vec(pos_q.astype(jnp.int32), bq, 0)
-    pkp = _pad_vec(pos_k.astype(jnp.int32), bk, 0)
+    # pad segments with distinct sentinels so padding never matches;
+    # q-side metadata rides as [Tq, 1] columns, k-side as [1, Tk] rows
+    sqp = _pad_vec(seg_q.astype(jnp.int32), bq, -1)[:, None]
+    skp = _pad_vec(seg_k.astype(jnp.int32), bk, -2)[None, :]
+    pqp = _pad_vec(pos_q.astype(jnp.int32), bq, 0)[:, None]
+    pkp = _pad_vec(pos_k.astype(jnp.int32), bk, 0)[None, :]
     out, lse = _vl_fwd(qp, kp, vp, sqp, skp, pqp, pkp, scale, causal, tq, tk)
     return out[:, :tq], (qp, kp, vp, sqp, skp, pqp, pkp, out, lse)
 
@@ -621,7 +628,8 @@ def _varlen_vjp_bwd(causal, scale, saved, dout):
     nq, nk = Tqp // bq, Tkp // bk
     group = H // Hkv
     dop = jnp.pad(dout, ((0, 0), (0, Tqp - tq), (0, 0)))
-    delta = jnp.sum(dop.astype(jnp.float32) * outp.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(dop.astype(jnp.float32) * outp.astype(jnp.float32),
+                    axis=-1, keepdims=True)
 
     dq = pl.pallas_call(
         functools.partial(_vl_bwd_dq_kernel, scale=scale, causal=causal,
@@ -629,8 +637,8 @@ def _varlen_vjp_bwd(causal, scale, saved, dout):
         grid=(H, nq, nk),
         in_specs=_vl_specs(bq, bk, D, group) + [
             pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, bq), lambda h, i, j: (h, i)),
-            pl.BlockSpec((1, bq), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((H, Tqp, D), qp.dtype),
@@ -644,8 +652,8 @@ def _varlen_vjp_bwd(causal, scale, saved, dout):
         grid=(H, nk, nq),
         in_specs=_vl_specs(bq, bk, D, group, transpose_grid=True) + [
             pl.BlockSpec((1, bq, D), lambda h, j, i: (h, i, 0)),
-            pl.BlockSpec((1, bq), lambda h, j, i: (h, i)),
-            pl.BlockSpec((1, bq), lambda h, j, i: (h, i)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda h, j, i: (h, j, 0)),
