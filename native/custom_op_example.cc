@@ -1,0 +1,51 @@
+// Example custom operator against the XLA FFI — the TPU-native analog of the
+// reference's custom C++ op extension (paddle/fluid/framework/
+// custom_operator.cc + PD_BUILD_OP macros in paddle/extension.h): a host
+// kernel registered as an XLA custom call, loadable at runtime via
+// paddle_tpu.utils.cpp_extension.
+//
+// axpby: out = a * x + b * y  (elementwise, f32), plus its backward kernels
+// (dx = a * g, dy = b * g) so the python wrapper can wire a custom_vjp.
+//
+// Built separately from libpaddle_tpu_native.so because it needs the XLA FFI
+// headers shipped with jaxlib (jax.ffi.include_dir()).
+
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error AxpbyImpl(float a, float b, ffi::Buffer<ffi::F32> x,
+                            ffi::Buffer<ffi::F32> y,
+                            ffi::ResultBuffer<ffi::F32> out) {
+  size_t n = x.element_count();
+  const float* xp = x.typed_data();
+  const float* yp = y.typed_data();
+  float* op = out->typed_data();
+  for (size_t i = 0; i < n; ++i) op[i] = a * xp[i] + b * yp[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(Axpby, AxpbyImpl,
+                              ffi::Ffi::Bind()
+                                  .Attr<float>("a")
+                                  .Attr<float>("b")
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+static ffi::Error ScaleImpl(float c, ffi::Buffer<ffi::F32> g,
+                            ffi::ResultBuffer<ffi::F32> out) {
+  size_t n = g.element_count();
+  const float* gp = g.typed_data();
+  float* op = out->typed_data();
+  for (size_t i = 0; i < n; ++i) op[i] = c * gp[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(Scale, ScaleImpl,
+                              ffi::Ffi::Bind()
+                                  .Attr<float>("c")
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

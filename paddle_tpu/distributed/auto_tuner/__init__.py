@@ -1,0 +1,3 @@
+from .tuner import AutoTuner, Recorder, default_candidates, tune
+
+__all__ = ["AutoTuner", "Recorder", "default_candidates", "tune"]

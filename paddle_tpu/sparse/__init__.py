@@ -1,0 +1,247 @@
+"""Sparse tensors (reference: python/paddle/sparse/ — creation.py
+sparse_coo_tensor/sparse_csr_tensor, unary/binary ops, matmul.py,
+nn/functional/activation.py; kernels paddle/phi/kernels/sparse/).
+
+TPU formulation: sparse COO rides on jax.experimental.sparse.BCOO — XLA
+compiles its gather/scatter formulation, which is the right trade on a
+dense-matrix machine (the reference's cuSPARSE segmented kernels have no
+TPU analog; scatter/gather lowering is what the hardware offers). CSR
+construction converts to the same BCOO representation (crows expanded to
+row indices). SparseTensor wraps the BCOO like Tensor wraps jax.Array and
+interoperates with dense Tensors via to_dense()."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor, to_tensor
+
+__all__ = [
+    "sparse_coo_tensor",
+    "sparse_csr_tensor",
+    "SparseTensor",
+    "is_same_shape",
+    "add",
+    "subtract",
+    "multiply",
+    "matmul",
+    "masked_matmul",
+    "transpose",
+    "nn",
+]
+
+
+class SparseTensor:
+    """COO sparse tensor over BCOO (reference: the SparseCooTensor handle,
+    paddle/phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -- properties ---------------------------------------------------- #
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    # -- conversions --------------------------------------------------- #
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseTensor(self._bcoo.sum_duplicates())
+
+    # -- arithmetic ---------------------------------------------------- #
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseTensor):
+        return x._bcoo
+    raise TypeError(f"expected SparseTensor, got {type(x)}")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """reference: python/paddle/sparse/creation.py sparse_coo_tensor.
+    `indices`: [ndim, nnz]; `values`: [nnz, ...dense_dims]."""
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(
+        np.asarray(indices))
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(
+        np.asarray(values))
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        val = val.astype(convert_dtype(dtype))
+    idx = jnp.swapaxes(idx.astype(jnp.int32), 0, 1)  # BCOO wants [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+        shape = shape + tuple(val.shape[1:])
+    bcoo = jsparse.BCOO((val, idx), shape=tuple(shape))
+    return SparseTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """reference: creation.py sparse_csr_tensor — stored as COO (crows
+    expanded), the TPU-friendly layout."""
+    crows = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    indices = np.stack([rows, cols])
+    return sparse_coo_tensor(indices, values, shape, dtype=dtype)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+# --------------------------------------------------------------------------- #
+# ops (reference python/paddle/sparse/binary.py, unary.py, matmul.py)
+# --------------------------------------------------------------------------- #
+
+
+def add(x, y):
+    if isinstance(y, SparseTensor):
+        bx, by = _as_bcoo(x), _as_bcoo(y)
+        out = jsparse.BCOO(
+            (jnp.concatenate([bx.data, by.data]),
+             jnp.concatenate([bx.indices, by.indices])),
+            shape=bx.shape).sum_duplicates()
+        return SparseTensor(out)
+    # sparse + dense -> dense
+    return Tensor(_as_bcoo(x).todense() + (
+        y._value if isinstance(y, Tensor) else jnp.asarray(y)))
+
+
+def subtract(x, y):
+    if isinstance(y, SparseTensor):
+        by = _as_bcoo(y)
+        neg = jsparse.BCOO((-by.data, by.indices), shape=by.shape)
+        return add(x, SparseTensor(neg))
+    return Tensor(_as_bcoo(x).todense() - (
+        y._value if isinstance(y, Tensor) else jnp.asarray(y)))
+
+
+def multiply(x, y):
+    bx = _as_bcoo(x)
+    if isinstance(y, SparseTensor):
+        # elementwise on matching sparsity: multiply against y's dense form
+        return SparseTensor(jsparse.BCOO(
+            (bx.data * _gather_dense(_as_bcoo(y).todense(), bx), bx.indices),
+            shape=bx.shape))
+    if isinstance(y, (int, float)):
+        return SparseTensor(jsparse.BCOO((bx.data * y, bx.indices),
+                                         shape=bx.shape))
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return SparseTensor(jsparse.BCOO(
+        (bx.data * _gather_dense(yv, bx), bx.indices), shape=bx.shape))
+
+
+def _gather_dense(dense, bcoo):
+    idx = tuple(bcoo.indices[:, d] for d in range(bcoo.indices.shape[1]))
+    return dense[idx]
+
+
+def matmul(x, y):
+    """Sparse @ dense (reference matmul.py; phi/kernels/sparse/matmul_kernel
+    -> here XLA's scatter/gather dot via bcoo_dot_general)."""
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    out = _as_bcoo(x) @ yv
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense sampled at `mask`'s sparsity (reference
+    masked_matmul)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    bm = _as_bcoo(mask)
+    rows = bm.indices[:, 0]
+    cols = bm.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows], jnp.swapaxes(yv, 0, 1)[cols])
+    return SparseTensor(jsparse.BCOO((vals, bm.indices),
+                                     shape=(xv.shape[0], yv.shape[1])))
+
+
+def transpose(x, perm):
+    bx = _as_bcoo(x)
+    return SparseTensor(jsparse.bcoo_transpose(bx, permutation=tuple(perm)))
+
+
+# --------------------------------------------------------------------------- #
+# sparse.nn (reference python/paddle/sparse/nn/)
+# --------------------------------------------------------------------------- #
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        bx = _as_bcoo(x)
+        return SparseTensor(jsparse.BCOO(
+            (jnp.maximum(bx.data, 0), bx.indices), shape=bx.shape))
+
+
+class _SparseNN:
+    ReLU = _SparseReLU
+
+    class functional:
+        @staticmethod
+        def relu(x):
+            return _SparseReLU()(x)
+
+        @staticmethod
+        def softmax(x, axis=-1):
+            """Row-wise softmax over stored values (reference
+            sparse/nn/functional/activation.py softmax: zeros stay zero)."""
+            bx = _as_bcoo(x)
+            if axis not in (-1, len(bx.shape) - 1):
+                raise NotImplementedError("sparse softmax: last axis only")
+            rows = bx.indices[:, 0]
+            n_rows = bx.shape[0]
+            mx = jnp.full(n_rows, -jnp.inf).at[rows].max(bx.data)
+            e = jnp.exp(bx.data - mx[rows])
+            denom = jnp.zeros(n_rows).at[rows].add(e)
+            return SparseTensor(jsparse.BCOO(
+                (e / denom[rows], bx.indices), shape=bx.shape))
+
+
+nn = _SparseNN()

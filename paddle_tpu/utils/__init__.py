@@ -1,0 +1,5 @@
+"""Utility namespace (reference: python/paddle/utils/)."""
+
+from . import cpp_extension
+
+__all__ = ["cpp_extension"]

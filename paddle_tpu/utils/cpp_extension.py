@@ -1,0 +1,153 @@
+"""Custom C++ operator extension (reference:
+python/paddle/utils/cpp_extension/cpp_extension.py `load` + the runtime
+registration in paddle/fluid/framework/custom_operator.cc).
+
+TPU formulation: a custom op is an XLA custom call. C++ sources written
+against the XLA FFI (headers shipped with jaxlib, jax.ffi.include_dir())
+are compiled to a shared library at load() time, each exported handler is
+registered with jax.ffi.register_ffi_target, and `custom_op` wraps the call
+into the eager dispatcher (run_op) with an optional user backward wired as
+jax.custom_vjp — the analog of PD_BUILD_OP + PD_BUILD_GRAD_OP. Host (CPU)
+custom calls cover the reference's CPU custom-op story; device-side custom
+kernels are Pallas (ops/pallas/), which needs no FFI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["load", "custom_op", "CppExtension", "get_build_directory"]
+
+_lock = threading.Lock()
+_loaded: dict = {}
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(os.path.expanduser("~"), ".cache",
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _ffi_include():
+    import jax
+
+    return jax.ffi.include_dir()
+
+
+def load(name, sources, extra_cxx_flags=None, build_directory=None,
+         verbose=False):
+    """Compile + register the handlers of a custom-op library (reference
+    cpp_extension.load). `sources`: list of .cc paths. Every symbol you
+    later wrap with `custom_op(..., target=...)` must be an
+    XLA_FFI_DEFINE_HANDLER_SYMBOL in the sources.
+
+    Returns the ctypes library; handlers register lazily in `custom_op`.
+    """
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        build_dir = build_directory or get_build_directory()
+        so_path = os.path.join(build_dir, f"{name}.so")
+        srcs = [os.path.abspath(s) for s in sources]
+        newest = max(os.path.getmtime(s) for s in srcs)
+        if not os.path.exists(so_path) or os.path.getmtime(so_path) < newest:
+            cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                   f"-I{_ffi_include()}", *srcs, "-o", so_path]
+            cmd += list(extra_cxx_flags or [])
+            if verbose:
+                print("[cpp_extension]", " ".join(cmd))
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=300)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"custom op build failed:\n{r.stderr[-2000:]}")
+        lib = ctypes.CDLL(so_path)
+        _loaded[name] = lib
+        return lib
+
+
+_registered: set = set()
+
+
+def _register(lib, symbol, target_name, platform):
+    import jax
+
+    key = (target_name, platform)
+    if key in _registered:
+        return
+    handler = getattr(lib, symbol)
+    jax.ffi.register_ffi_target(
+        target_name, jax.ffi.pycapsule(handler), platform=platform)
+    _registered.add(key)
+
+
+def custom_op(lib, symbol, *, name=None, platform="cpu", backward=None):
+    """Wrap a registered FFI handler as an eager op (reference: the python
+    API objects custom_operator.cc synthesizes per op, plus
+    PD_BUILD_GRAD_OP when `backward` is given).
+
+    Returns fn(*tensors, out_shape=None, out_dtype=None, **attrs) -> Tensor.
+    `backward(residual_tensors, grad) -> tuple_of_input_grads` may itself
+    call other custom ops.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor, run_op, to_tensor
+
+    target = name or symbol.lower()
+    _register(lib, symbol, target, platform)
+
+    def call_raw(values, out_aval, attrs):
+        fn = jax.ffi.ffi_call(target, out_aval)
+        return fn(*values, **attrs)
+
+    def op(*tensors, out_shape=None, out_dtype=None, **attrs):
+        ts = [t if isinstance(t, Tensor) else to_tensor(t) for t in tensors]
+        shape = tuple(out_shape) if out_shape is not None else tuple(ts[0].shape)
+        dtype = out_dtype or ts[0]._value.dtype
+        out_aval = jax.ShapeDtypeStruct(shape, dtype)
+
+        if backward is None:
+            return run_op(f"custom_{target}",
+                          lambda *vs: call_raw(vs, out_aval, attrs), ts)
+
+        @jax.custom_vjp
+        def fwd(*vs):
+            return call_raw(vs, out_aval, attrs)
+
+        def fwd_res(*vs):
+            return fwd(*vs), vs
+
+        def bwd(res, g):
+            grads = backward([Tensor(v) for v in res], Tensor(g), **attrs)
+            grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            out = []
+            for v, gr in zip(res, grads):
+                if gr is None:
+                    import numpy as np
+
+                    out.append(np.zeros(jnp.shape(v), jax.dtypes.float0))
+                else:
+                    out.append(gr._value if isinstance(gr, Tensor) else gr)
+            return tuple(out)
+
+        fwd.defvjp(fwd_res, bwd)
+        return run_op(f"custom_{target}", fwd, ts)
+
+    op.__name__ = target
+    return op
+
+
+class CppExtension:
+    """setuptools-style descriptor (reference CppExtension); accepted by
+    load() callers for API parity."""
+
+    def __init__(self, sources, **kwargs):
+        self.sources = list(sources)
+        self.kwargs = kwargs

@@ -1,0 +1,131 @@
+"""Auto-tuner tests (reference: python/paddle/distributed/auto_tuner/ —
+tuner.py AutoTuner, prune.py static+history pruning, recorder.py)."""
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner,
+    Recorder,
+    default_candidates,
+    tune,
+)
+from paddle_tpu.distributed.auto_tuner.tuner import (
+    estimate_memory_bytes,
+    prune_by_memory,
+    prune_by_mp,
+    prune_by_pp,
+)
+
+MODEL_CFG = {"hidden_size": 64, "num_layers": 4, "num_heads": 4,
+             "vocab_size": 1024, "seq_length": 32}
+
+
+def test_candidates_and_static_pruning():
+    tuner_cfg = {
+        "num_devices": 8,
+        "global_batch_size": 8,
+        "model_cfg": MODEL_CFG,
+        "micro_batch_size": [1, 2],
+    }
+    cands = default_candidates(tuner_cfg)
+    assert all(
+        c["dp_degree"] * c["mp_degree"] * c["pp_degree"] * c["sharding_degree"] == 8
+        for c in cands)
+    # mp=8 cannot divide num_heads=4 -> pruned
+    bad = dict(cands[0], mp_degree=8, dp_degree=1, pp_degree=1,
+               sharding_degree=1)
+    assert prune_by_mp(tuner_cfg, bad) is not None
+    # pp=8 cannot divide num_layers=4 -> pruned
+    bad_pp = dict(cands[0], pp_degree=8, dp_degree=1, mp_degree=1,
+                  sharding_degree=1)
+    assert prune_by_pp(tuner_cfg, bad_pp) is not None
+
+    tuner = AutoTuner(tuner_cfg)
+    seen = []
+    while True:
+        c = tuner.search_once()
+        if c is None:
+            break
+        seen.append(c)
+        tuner.add_cfg(dict(c))
+    assert seen, "no surviving candidates"
+    assert tuner.pruned, "nothing was pruned"
+    # every survivor obeys the divisibility laws
+    for c in seen:
+        assert MODEL_CFG["num_heads"] % c["mp_degree"] == 0
+        assert MODEL_CFG["num_layers"] % c["pp_degree"] == 0
+
+
+def test_memory_pruning_and_history():
+    tuner_cfg = {
+        "num_devices": 8,
+        "global_batch_size": 8,
+        "model_cfg": dict(MODEL_CFG, hidden_size=4096, num_layers=32),
+        "max_mem_usage_bytes": int(1e9),  # 1 GB cap: big configs must die
+        "micro_batch_size": [1],
+    }
+    full = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sharding_stage": 1,
+            "micro_batch_size": 1, "use_recompute": True,
+            "global_batch_size": 8}
+    assert prune_by_memory(tuner_cfg, full) is not None
+    # history pruning: a config >= a known-OOM estimate is skipped
+    from paddle_tpu.distributed.auto_tuner.tuner import prune_by_history
+
+    hist = [{"error": "oom",
+             "mem_estimate": estimate_memory_bytes(tuner_cfg, full) - 1}]
+    assert prune_by_history(tuner_cfg, full, hist) is not None
+
+
+def test_recorder_best_and_csv(tmp_path):
+    r = Recorder()
+    r.add_cfg(dp_degree=8, step_time=0.5)
+    r.add_cfg(dp_degree=4, step_time=0.2)
+    r.add_cfg(dp_degree=2, step_time=None, error="oom")
+    best, err = r.get_best()
+    assert not err and best["dp_degree"] == 4
+    p = str(tmp_path / "history.csv")
+    r.store_history(p)
+    loaded, missing = r.load_history(p)
+    assert not missing and len(loaded) == 3
+
+
+def test_tune_measures_and_picks_best():
+    """End-to-end sweep on the 8-device CPU mesh over a restricted grid —
+    each trial builds a real DistributedTrainStep (reference: subprocess
+    trials with timeout, tuner.py + launch integration)."""
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, GPTConfig
+
+    cfg_model = GPTConfig(vocab_size=MODEL_CFG["vocab_size"],
+                          hidden_size=MODEL_CFG["hidden_size"],
+                          num_layers=2, num_heads=4,
+                          max_position_embeddings=64)
+    crit = GPTPretrainingCriterion(cfg_model)
+
+    tuner_cfg = {
+        "num_devices": 4,
+        "global_batch_size": 8,
+        "model_cfg": dict(MODEL_CFG, num_layers=2),
+        # restricted grid: 3 feasible points
+        "mp_degree": [1, 2],
+        "pp_degree": [1],
+        "sharding_degree": [1, 2],
+        "dp_degree": [1, 2, 4],
+        "micro_batch_size": [2],
+    }
+
+    best, rec = tune(
+        lambda c: GPTForCausalLM(cfg_model),
+        lambda lg, lb: crit(lg, lb),
+        lambda m: opt.AdamW(learning_rate=1e-3, parameters=m.parameters()),
+        tuner_cfg, devices=jax.devices()[:4], steps=1)
+    assert best is not None and best["step_time"] > 0
+    measured = [h for h in rec.history if h.get("step_time")]
+    assert len(measured) >= 2, rec.history
+    assert all(np.isfinite(h["loss"]) for h in measured)
+    assert best["step_time"] == min(h["step_time"] for h in measured)

@@ -1,0 +1,83 @@
+"""Auto-parallel Engine (reference:
+python/paddle/distributed/auto_parallel/static/engine.py fit/evaluate/
+predict/save/load over a parallelized program)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+
+def _setup():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    crit = nn.MSELoss()
+    optimizer = opt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    return model, crit, optimizer
+
+
+def _data(n=32):
+    rng = np.random.default_rng(0)
+    x = np.asarray(rng.normal(size=(n, 16)), np.float32)
+    w = np.asarray(rng.normal(size=(16, 4)), np.float32)
+    return x, x @ w * 0.1
+
+
+def test_engine_fit_evaluate_predict():
+    model, crit, optimizer = _setup()
+    strategy = Strategy()
+    strategy.sharding.enable = True
+    strategy.sharding.degree = 2
+    strategy.mp_degree = 1
+    engine = Engine(model=model, loss=crit, optimizer=optimizer,
+                    strategy=strategy)
+    x, y = _data()
+    hist = engine.fit(train_data=(x, y), batch_size=8, epochs=3)
+    assert hist["loss"][-1] < hist["loss"][0]
+    ev = engine.evaluate(valid_data=(x, y), batch_size=8)
+    assert np.isfinite(ev["loss"])
+    preds = engine.predict(test_data=(x, y), batch_size=8)
+    assert preds and preds[0].shape == (8, 4)
+    dist.env.set_global_mesh(None)
+
+
+def test_engine_save_load(tmp_path):
+    model, crit, optimizer = _setup()
+    engine = Engine(model=model, loss=crit, optimizer=optimizer)
+    x, y = _data(16)
+    engine.fit(train_data=(x, y), batch_size=8, epochs=1)
+    p = str(tmp_path / "ckpt")
+    engine.save(p)
+
+    model2, crit2, opt2 = _setup()
+    engine2 = Engine(model=model2, loss=crit2, optimizer=opt2)
+    engine2.load(p)
+    xa = paddle.to_tensor(x[:4])
+    np.testing.assert_allclose(model2(xa).numpy(), model(xa).numpy(),
+                               atol=1e-6)
+    dist.env.set_global_mesh(None)
+
+
+def test_engine_rejects_oversized_mesh():
+    import pytest
+
+    model, crit, optimizer = _setup()
+    strategy = Strategy()
+    strategy.mp_degree = 64
+    engine = Engine(model=model, loss=crit, optimizer=optimizer,
+                    strategy=strategy)
+    with pytest.raises(ValueError, match="exceeds"):
+        engine.prepare()
+    dist.env.set_global_mesh(None)
+
+
+def test_engine_cost_model():
+    from paddle_tpu.models import GPTForCausalLM, gpt3_tiny
+
+    cfg = gpt3_tiny()
+    model = GPTForCausalLM(cfg)
+    engine = Engine(model=model, loss=lambda a, b: a, optimizer=None)
+    assert engine.cost() > 0

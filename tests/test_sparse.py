@@ -1,0 +1,83 @@
+"""paddle.sparse subset (reference: python/paddle/sparse/ creation/binary/
+matmul + sparse/nn; kernels paddle/phi/kernels/sparse/)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    indices = [[0, 1, 2], [1, 0, 2]]
+    values = [1.0, 2.0, 3.0]
+    return sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+
+
+def test_coo_create_dense_roundtrip():
+    s = _coo()
+    assert s.shape == [3, 3] and s.nnz == 3
+    dense = s.to_dense().numpy()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[1, 0], ref[2, 2] = 1, 2, 3
+    np.testing.assert_allclose(dense, ref)
+    np.testing.assert_array_equal(s.indices().numpy(),
+                                  [[0, 1, 2], [1, 0, 2]])
+    np.testing.assert_allclose(s.values().numpy(), [1, 2, 3])
+
+
+def test_csr_create():
+    s = sparse.sparse_csr_tensor(
+        crows=[0, 1, 2, 3], cols=[1, 0, 2], values=[1.0, 2.0, 3.0],
+        shape=[3, 3])
+    np.testing.assert_allclose(s.to_dense().numpy(), _coo().to_dense().numpy())
+
+
+def test_add_sub_mul():
+    a, b = _coo(), _coo()
+    np.testing.assert_allclose((a + b).to_dense().numpy(),
+                               2 * a.to_dense().numpy())
+    np.testing.assert_allclose((a - b).to_dense().numpy(), 0.0)
+    np.testing.assert_allclose((a * 2.0).to_dense().numpy(),
+                               2 * a.to_dense().numpy())
+    dense = paddle.to_tensor(np.full((3, 3), 2.0, np.float32))
+    np.testing.assert_allclose((a * dense).to_dense().numpy(),
+                               2 * a.to_dense().numpy())
+
+
+def test_matmul_and_masked_matmul():
+    s = _coo()
+    d = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    out = sparse.matmul(s, d)
+    np.testing.assert_allclose(out.numpy(),
+                               s.to_dense().numpy() @ d.numpy())
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((3, 4))
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.default_rng(1).standard_normal((4, 3))
+                         .astype(np.float32))
+    mm = sparse.masked_matmul(x, y, s)
+    full = x.numpy() @ y.numpy()
+    mask = (s.to_dense().numpy() != 0)
+    np.testing.assert_allclose(mm.to_dense().numpy(), full * mask, rtol=1e-5)
+
+
+def test_relu_and_softmax():
+    idx = [[0, 0, 1], [0, 1, 2]]
+    s = sparse.sparse_coo_tensor(idx, [-1.0, 2.0, -3.0], shape=[2, 3])
+    r = sparse.nn.functional.relu(s)
+    np.testing.assert_allclose(r.values().numpy(), [0.0, 2.0, 0.0])
+
+    sm = sparse.nn.functional.softmax(_coo())
+    dense = sm.to_dense().numpy()
+    # each row has ONE stored value -> softmax over stored entries = 1
+    np.testing.assert_allclose(dense[dense != 0], 1.0)
+
+    s2 = sparse.sparse_coo_tensor([[0, 0], [0, 1]], [1.0, 2.0], shape=[1, 3])
+    sm2 = sparse.nn.functional.softmax(s2).values().numpy()
+    e = np.exp(np.array([1.0, 2.0]) - 2.0)
+    np.testing.assert_allclose(sm2, e / e.sum(), rtol=1e-5)
+
+
+def test_transpose():
+    t = sparse.transpose(_coo(), [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(),
+                               _coo().to_dense().numpy().T)
