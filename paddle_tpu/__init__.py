@@ -117,3 +117,7 @@ from . import static  # noqa: E402
 from .static import disable_static, enable_static  # noqa: E402
 from . import utils  # noqa: E402
 from . import sparse  # noqa: E402
+from . import quantization  # noqa: E402
+from . import audio  # noqa: E402
+from . import text  # noqa: E402
+from . import onnx  # noqa: E402

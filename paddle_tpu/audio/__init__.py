@@ -1,0 +1,13 @@
+"""Audio features (reference: python/paddle/audio/ — functional/functional.py
+hz_to_mel/mel_to_hz/compute_fbank_matrix/create_dct/power_to_db,
+features/layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC).
+
+TPU formulation: everything composes from the fft module (XLA FftOp) plus
+dense matmuls — framing via strided gather, mel projection as one [freq,
+mel] matmul the MXU eats. All layers are differentiable run_ops."""
+
+from . import functional
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

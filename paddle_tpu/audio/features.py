@@ -1,0 +1,118 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram :33, MelSpectrogram :117, LogMelSpectrogram :219, MFCC :315)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from ..framework.core import Tensor, run_op, to_tensor
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length):
+    """[..., T] -> [..., n_frames, frame_length] via strided gather."""
+    n = (x.shape[-1] - frame_length) // hop_length + 1
+    idx = (jnp.arange(n)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]
+
+
+class Spectrogram(nn.Layer):
+    """STFT power spectrogram [..., 1 + n_fft//2, n_frames]
+    (reference layers.py:33)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length, dtype=dtype)._value
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self.register_buffer("window", Tensor(w))
+
+    def forward(self, x):
+        t = x if isinstance(x, Tensor) else to_tensor(x)
+        n_fft, hop = self.n_fft, self.hop_length
+        center, pad_mode, power = self.center, self.pad_mode, self.power
+
+        def fn(v, w):
+            if center:
+                pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+                v = jnp.pad(v, pad, mode=pad_mode)
+            frames = _frame(v, n_fft, hop) * w
+            spec = jnp.fft.rfft(frames, axis=-1)
+            mag = jnp.abs(spec)
+            if power != 1.0:
+                mag = mag ** power
+            return jnp.swapaxes(mag, -1, -2)  # [..., freq, time]
+
+        return run_op("spectrogram", fn, [t, self.window])
+
+
+class MelSpectrogram(nn.Layer):
+    """reference layers.py:117."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.register_buffer(
+            "fbank", F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                            htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return run_op("mel_project",
+                      lambda s, fb: jnp.einsum("...ft,mf->...mt", s, fb),
+                      [spec, self.fbank])
+
+
+class LogMelSpectrogram(nn.Layer):
+    """reference layers.py:219."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(nn.Layer):
+    """reference layers.py:315."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, top_db=None,
+                 dtype="float32", **kw):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft,
+                                        hop_length=hop_length, n_mels=n_mels,
+                                        f_min=f_min, f_max=f_max,
+                                        top_db=top_db, dtype=dtype, **kw)
+        self.register_buffer("dct", F.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return run_op("mfcc_dct",
+                      lambda s, d: jnp.einsum("...mt,mc->...ct", s, d),
+                      [lm, self.dct])

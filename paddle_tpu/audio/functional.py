@@ -1,0 +1,126 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/
+functional.py and window.py get_window)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "create_dct", "power_to_db", "get_window",
+]
+
+
+def hz_to_mel(freq, htk=False):
+    """reference functional.py hz_to_mel (slaney default)."""
+    scalar = not isinstance(freq, (Tensor, np.ndarray, list))
+    f = np.asarray(freq._value if isinstance(freq, Tensor) else freq,
+                   dtype=np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                       mel)
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk=False):
+    """reference functional.py mel_to_hz."""
+    scalar = not isinstance(mel, (Tensor, np.ndarray, list))
+    m = np.asarray(mel._value if isinstance(mel, Tensor) else mel,
+                   dtype=np.float64)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = np.where(m >= min_log_mel,
+                     min_log_hz * np.exp(logstep * (m - min_log_mel)), f)
+    return float(f) if scalar else f
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank (reference
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb.astype(dtype)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference functional.py create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.T.astype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """reference functional.py power_to_db."""
+    t = spect if isinstance(spect, Tensor) else to_tensor(spect)
+
+    def fn(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return run_op("power_to_db", fn, [t])
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """reference window.py get_window (hann/hamming/blackman/ones)."""
+    n = np.arange(win_length)
+    den = win_length if fftbins else win_length - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / den)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / den)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / den)
+             + 0.08 * np.cos(4 * math.pi * n / den))
+    elif window in ("ones", "boxcar", "rectangular"):
+        w = np.ones(win_length)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w.astype(dtype)))

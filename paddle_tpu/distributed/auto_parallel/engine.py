@@ -106,8 +106,10 @@ class Engine:
         if isinstance(data, (tuple, list)) and len(data) == 2:
             x, y = data
             x, y = np.asarray(x), np.asarray(y)
-            n = len(x)
-            for lo in range(0, n - n % batch_size or n, batch_size):
+            # trailing partial batch included: a dropped remainder would be
+            # silent missing predictions / skewed eval loss (costs one extra
+            # compile for the odd shape)
+            for lo in range(0, len(x), batch_size):
                 yield (paddle.to_tensor(x[lo:lo + batch_size]),
                        paddle.to_tensor(y[lo:lo + batch_size]))
             return
@@ -129,20 +131,29 @@ class Engine:
         return self.history
 
     def evaluate(self, valid_data=None, batch_size=1, steps=None, **kw):
-        """reference evaluate :1723."""
-        self.prepare()
+        """reference evaluate :1723. Works without an optimizer (eval-only
+        engines run the model eagerly under the global mesh)."""
         losses = []
+        if self._optimizer is not None:
+            self.prepare()
+        elif self._mesh is None:
+            self._mesh = self._build_mesh()
         for i, (x, y) in enumerate(self._batches(valid_data, batch_size)):
             if steps is not None and i >= steps:
                 break
-            losses.append(float(self._step.evaluate(x, y)))
+            if self._optimizer is not None:
+                losses.append(float(self._step.evaluate(x, y)))
+            else:
+                losses.append(float(self._loss(self._model(x), y)))
         return {"loss": float(np.mean(losses)) if losses else None}
 
     def predict(self, test_data=None, batch_size=1, steps=None, **kw):
-        """reference predict :1837."""
+        """reference predict :1837 — inference-only: no optimizer/loss
+        needed, no train step built."""
         import paddle_tpu as paddle
 
-        self.prepare()
+        if self._mesh is None:
+            self._mesh = self._build_mesh()
         was_training = self._model.training
         self._model.eval()
         outs = []
@@ -163,7 +174,13 @@ class Engine:
         """reference save :2324 — distributed checkpoint of model (+opt)."""
         from ...framework.io import save as fsave
 
-        self._step and self._step.sync_weights()
+        if self._step is not None:
+            self._step.sync_weights()
+            # write the device-side moments back so the .pdopt checkpoint
+            # carries the real optimizer state, not init zeros
+            sync_opt = getattr(self._step, "sync_optimizer", None)
+            if sync_opt is not None:
+                sync_opt()
         fsave(self._model.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             fsave(self._optimizer.state_dict(), path + ".pdopt")

@@ -118,12 +118,29 @@ def functional_call(layer: Layer, param_vals, buffer_vals, args, kwargs=None, tr
             layer.train() if prev_training else layer.eval()
 
 
+def _is_trace_ineligible(e) -> bool:
+    """Errors meaning 'this Python frame cannot be traced' — data-dependent
+    control flow / shapes (the reference SOT's ineligible-frame set,
+    python/paddle/jit/sot/translate.py BreakGraphError)."""
+    import jax.errors as jerr
+
+    return isinstance(e, (jerr.TracerBoolConversionError,
+                          jerr.ConcretizationTypeError,
+                          jerr.TracerArrayConversionError,
+                          jerr.TracerIntegerConversionError,
+                          jerr.NonConcreteBooleanIndexError))
+
+
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
     """Decorator/wrapper: jit a Tensor-level callable or a Layer's forward.
 
     Shape-signature guarding comes from jax.jit's tracing cache — a new input
     (shape, dtype) signature triggers a retrace, matching the reference SOT
-    guard semantics (python/paddle/jit/sot/translate.py:97-106).
+    guard semantics (python/paddle/jit/sot/translate.py:97-106). Frames the
+    tracer cannot swallow (data-dependent Python control flow, concretized
+    shapes) permanently FALL BACK to eager execution — the reference SOT's
+    dygraph fallback for ineligible frames (translate.py BreakGraphError
+    path) rather than a user-facing crash.
     """
     if function is None:
         return lambda f: to_static(f, input_spec=input_spec)
@@ -146,13 +163,24 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
             out = fn(*args)
         return _unwrap_pytree(out)
 
+    fell_back = [False]
+
     @functools.wraps(fn)
     def wrapper(*args):
+        if fell_back[0]:
+            return fn(*args)
         raw = _unwrap_pytree(list(args))
-        out = traced(raw)
+        try:
+            out = traced(raw)
+        except Exception as e:
+            if not _is_trace_ineligible(e):
+                raise
+            fell_back[0] = True
+            return fn(*args)
         return _wrap_pytree(out)
 
     wrapper._original_fn = fn
+    wrapper._sot_fallen_back = fell_back
     return wrapper
 
 
@@ -160,10 +188,12 @@ def _make_layer_jit(layer, orig_forward):
     """jit a Layer's forward: params/buffers become traced args so weight
     updates don't trigger recompiles; buffers update functionally."""
     jit_cache = {}
+    fell_back = [False]
 
     def forward(*args, **kwargs):
-        if kwargs:
-            # kwargs would be baked into the trace as constants; run eagerly
+        if kwargs or fell_back[0]:
+            # kwargs would be baked into the trace as constants; ineligible
+            # frames run eagerly forever (SOT dygraph fallback)
             return orig_forward(*args, **kwargs)
         state = _ModuleState(layer)
         p_vals, b_vals = state.values()
@@ -186,11 +216,18 @@ def _make_layer_jit(layer, orig_forward):
 
             jit_cache[key] = step
         raw_args = _unwrap_pytree(list(args))
-        out, new_bufs = jit_cache[key](p_vals, b_vals, rnd.next_key(), raw_args)
+        try:
+            out, new_bufs = jit_cache[key](p_vals, b_vals, rnd.next_key(), raw_args)
+        except Exception as e:
+            if not _is_trace_ineligible(e):
+                raise
+            fell_back[0] = True
+            return orig_forward(*args)
         for k, v in new_bufs.items():
             state.buffers[k]._value = v
         return _wrap_pytree(out)
 
+    forward._sot_fallen_back = fell_back
     return forward
 
 

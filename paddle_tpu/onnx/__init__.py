@@ -1,0 +1,35 @@
+"""ONNX export surface (reference: python/paddle/onnx/export.py — export()
+delegating to paddle2onnx).
+
+TPU formulation: the portable serialized graph on this stack is StableHLO
+(the jit.save artifact), which is what XLA-family runtimes consume — it
+plays the role ONNX plays in the reference's deployment story. export()
+therefore emits the StableHLO bundle at `path`; when the `onnx` package is
+installed (not in this image) a real ONNX conversion could be layered on
+top, so its absence raises only if `format='onnx'` is forced."""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, format=None,
+           **configs):
+    """reference: paddle.onnx.export (export.py). Saves the traced program
+    as a StableHLO bundle via jit.save; `format='onnx'` requires the onnx
+    package."""
+    if format == "onnx":
+        try:
+            import onnx  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "the `onnx` package is not available in this environment; "
+                "export() emits a StableHLO bundle instead (omit "
+                "format='onnx')") from e
+        raise NotImplementedError(
+            "direct ONNX serialization is not implemented; use the "
+            "StableHLO bundle (default format) with an XLA-family runtime")
+    from ..jit import save as jit_save
+
+    jit_save(layer, path, input_spec=input_spec)
+    return path

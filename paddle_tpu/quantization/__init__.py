@@ -1,0 +1,200 @@
+"""Quantization (reference: python/paddle/quantization/ — config.py
+QuantConfig, ptq.py PTQ, qat.py QAT, observers/abs_max.py, and the
+quantized layers in nn/quant/; kernel analogs
+paddle/phi/kernels/gpu/quantize_linear_kernel.cu).
+
+TPU formulation: weight-only int8 is the quantization that pays on TPU
+(int8 MXU runs at 2x bf16 peak; activations stay bf16/f32 and XLA fuses the
+dequant scale into the matmul). PTQ calibrates per-channel abs-max scales
+by running observer-wrapped forwards, then convert() swaps Linear layers
+for QuantizedLinear holding int8 weights + scales. QAT wraps weights in a
+straight-through fake-quant so training sees quantization error while
+gradients flow unquantized."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from ..framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "QuantConfig",
+    "AbsMaxObserver",
+    "PTQ",
+    "QAT",
+    "QuantizedLinear",
+    "quantize_weight",
+    "fake_quant",
+]
+
+
+def quantize_weight(w, bits=8, axis=0):
+    """Per-channel symmetric abs-max quantization (reference
+    observers/abs_max.py). Returns (int8_values, scale)."""
+    wv = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    qmax = 2 ** (bits - 1) - 1
+    reduce_axes = tuple(i for i in range(wv.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(wv), axis=reduce_axes, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(wv / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return Tensor(q), Tensor(scale.astype(jnp.float32))
+
+
+def fake_quant(x, scale=None, bits=8):
+    """Straight-through quant-dequant (reference qat.py FakeQuant): forward
+    sees the rounded value, backward is identity."""
+    t = x if isinstance(x, Tensor) else to_tensor(x)
+    qmax = 2 ** (bits - 1) - 1
+
+    def fn(v):
+        s = (jnp.max(jnp.abs(v)) / qmax) if scale is None else scale
+        s = jnp.where(s == 0, 1.0, s)
+        q = jnp.clip(jnp.round(v / s), -qmax - 1, qmax) * s
+        # straight-through estimator: identity gradient
+        return v + jax.lax.stop_gradient(q - v)
+
+    return run_op("fake_quant", fn, [t])
+
+
+class AbsMaxObserver:
+    """reference observers/abs_max.py AbsmaxObserver."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(v))))
+
+    def scale(self):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return (self._absmax / qmax) if self._absmax else 1.0
+
+
+class QuantConfig:
+    """reference config.py QuantConfig — which layer types quantize and
+    with what observer."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight or AbsMaxObserver
+        self._types = [nn.Linear]
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        types = layer_types if isinstance(layer_types, (list, tuple)) else [layer_types]
+        self._types.extend(t for t in types if t not in self._types)
+        if weight is not None:
+            self.weight = weight
+        return self
+
+
+class QuantizedLinear(nn.Layer):
+    """int8-weight Linear (reference nn/quant/ QuantedLinear): stores the
+    quantized weight + per-output-channel scale; the matmul dequantizes via
+    the fused scale multiply XLA folds into the dot."""
+
+    def __init__(self, linear: nn.Linear, bits=8):
+        super().__init__()
+        qw, scale = quantize_weight(linear.weight, bits=bits, axis=1)
+        self.register_buffer("weight_quant", qw)
+        self.register_buffer("weight_scale", scale)
+        self.bias = linear.bias
+        self.bits = bits
+
+    def forward(self, x):
+        t = x if isinstance(x, Tensor) else to_tensor(x)
+        b = self.bias
+
+        def fn(v, qw, sc, *rest):
+            out = jnp.matmul(v, qw.astype(v.dtype) * sc.astype(v.dtype))
+            if rest:
+                out = out + rest[0]
+            return out
+
+        ins = [t, self.weight_quant, self.weight_scale]
+        if b is not None:
+            ins.append(b)
+        return run_op("quantized_linear", fn, ins)
+
+
+class PTQ:
+    """Post-training quantization driver (reference ptq.py PTQ):
+    quantize() hooks an activation observer onto each target layer's
+    forward, calibration runs feed them, convert() swaps in QuantizedLinear
+    (int8 weights from weight statistics; the calibrated activation scale
+    rides along on the layer for int8-activation deployment)."""
+
+    def __init__(self, q_config: QuantConfig | None = None):
+        self.config = q_config or QuantConfig()
+        self._observed: list[tuple] = []
+
+    def quantize(self, model, inplace=False):
+        self._observed = []
+        for name, sub in list(model.named_sublayers()):
+            if any(isinstance(sub, t) for t in self.config._types) and \
+                    not getattr(sub, "_ptq_observed", False):
+                obs = (self.config.activation or AbsMaxObserver)()
+                orig = sub.forward
+
+                def make_fwd(orig, obs):
+                    def fwd(x):
+                        obs.observe(x)
+                        return orig(x)
+                    return fwd
+
+                sub.forward = make_fwd(orig, obs)
+                sub._ptq_observed = True
+                sub._ptq_orig_forward = orig
+                self._observed.append((model, name, sub, obs))
+        return model
+
+    def activation_scales(self):
+        return {name: obs.scale() for _, name, _, obs in self._observed}
+
+    def convert(self, model, inplace=False, bits=8):
+        """Swap each observed Linear for its QuantizedLinear carrying the
+        calibrated activation scale."""
+        for owner, name, sub, obs in self._observed:
+            sub.forward = sub._ptq_orig_forward  # unhook the observer
+            parts = name.split(".")
+            parent = owner
+            for p in parts[:-1]:
+                parent = getattr(parent, p)
+            ql = QuantizedLinear(sub, bits=bits)
+            ql.activation_scale = obs.scale()
+            setattr(parent, parts[-1], ql)
+        return model
+
+
+class QAT:
+    """Quantization-aware training (reference qat.py QAT): wraps target
+    layers' forward with straight-through fake-quant on the weight."""
+
+    def __init__(self, q_config: QuantConfig | None = None):
+        self.config = q_config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        for _name, sub in model.named_sublayers():
+            if any(isinstance(sub, t) for t in self.config._types) and \
+                    not getattr(sub, "_qat_wrapped", False):
+                orig = sub.forward
+                weight = sub.weight
+
+                def make_fwd(orig, weight):
+                    def fwd(x):
+                        saved = weight._value
+                        weight._value = fake_quant(Tensor(saved))._value
+                        try:
+                            return orig(x)
+                        finally:
+                            weight._value = saved
+                    return fwd
+
+                sub.forward = make_fwd(orig, weight)
+                sub._qat_wrapped = True
+        return model

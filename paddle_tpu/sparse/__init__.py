@@ -230,18 +230,22 @@ class _SparseNN:
 
         @staticmethod
         def softmax(x, axis=-1):
-            """Row-wise softmax over stored values (reference
-            sparse/nn/functional/activation.py softmax: zeros stay zero)."""
+            """Softmax over stored values per last-axis lane (reference
+            sparse/nn/functional/activation.py softmax: zeros stay zero).
+            Entries group by ALL leading indices, so ndim > 2 normalizes
+            per (batch..., row) lane, not per dim-0 value."""
             bx = _as_bcoo(x)
             if axis not in (-1, len(bx.shape) - 1):
                 raise NotImplementedError("sparse softmax: last axis only")
-            rows = bx.indices[:, 0]
-            n_rows = bx.shape[0]
-            mx = jnp.full(n_rows, -jnp.inf).at[rows].max(bx.data)
-            e = jnp.exp(bx.data - mx[rows])
-            denom = jnp.zeros(n_rows).at[rows].add(e)
+            lead = bx.indices[:, :-1].astype(jnp.int64)
+            strides = np.cumprod([1] + list(bx.shape[:-1][::-1]))[::-1][1:]
+            keys = (lead * jnp.asarray(strides.copy(), jnp.int64)).sum(axis=1)
+            n_lanes = int(np.prod(bx.shape[:-1]))
+            mx = jnp.full(n_lanes, -jnp.inf).at[keys].max(bx.data)
+            e = jnp.exp(bx.data - mx[keys])
+            denom = jnp.zeros(n_lanes).at[keys].add(e)
             return SparseTensor(jsparse.BCOO(
-                (e / denom[rows], bx.indices), shape=bx.shape))
+                (e / denom[keys], bx.indices), shape=bx.shape))
 
 
 nn = _SparseNN()

@@ -1,0 +1,94 @@
+"""Text utilities (reference: python/paddle/text/ — viterbi_decode.py
+ViterbiDecoder/viterbi_decode; the dataset zoo there is download-based and
+out of scope in a zero-egress build, documented per SURVEY §2.6.12).
+
+TPU formulation: Viterbi is a lax.scan over time with a [B, T, T] max-plus
+step — static shapes, no host loop (the reference's viterbi_decode_kernel
+is a CUDA time loop)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from ..framework.core import Tensor, run_op, to_tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """reference: paddle.text.viterbi_decode — returns (scores, paths).
+
+    potentials: [B, T, N] emission scores; transition_params: [N, N];
+    lengths: [B] valid steps (default: full length). With
+    include_bos_eos_tag, row N-2 is BOS and N-1 is EOS like the reference.
+    """
+    pot = potentials if isinstance(potentials, Tensor) else to_tensor(potentials)
+    trans = (transition_params if isinstance(transition_params, Tensor)
+             else to_tensor(transition_params))
+    B, T, N = pot.shape
+    if lengths is None:
+        import numpy as np
+
+        lengths = to_tensor(np.full((B,), T, np.int64))
+    lens = lengths if isinstance(lengths, Tensor) else to_tensor(lengths)
+
+    def fn(p, tr, ln):
+        ln = ln.astype(jnp.int32)
+        if include_bos_eos_tag:
+            # start from BOS row, end with EOS column
+            alpha0 = p[:, 0] + tr[N - 2][None, :]
+        else:
+            alpha0 = p[:, 0]
+
+        def step(carry, inp):
+            alpha, t = carry
+            emit = inp  # [B, N]
+            scores = alpha[:, :, None] + tr[None]  # [B, from, to]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            alpha_new = jnp.max(scores, axis=1) + emit
+            # freeze lanes past their length
+            active = (t < ln)[:, None]
+            alpha_new = jnp.where(active, alpha_new, alpha)
+            best_prev = jnp.where(active, best_prev, jnp.arange(N)[None])
+            return (alpha_new, t + 1), best_prev
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (alpha0, jnp.ones((), jnp.int32)),
+            jnp.swapaxes(p[:, 1:], 0, 1))  # [T-1, B, N]
+        if include_bos_eos_tag:
+            alpha = alpha + tr[:, N - 1][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)  # [B]
+
+        def back(carry, bp):
+            # processing index i (reverse): carry holds tag_{i+1}; emit it,
+            # step to tag_i = backptrs[i][tag_{i+1}]
+            tag, t = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            tag_new = jnp.where(t < ln, prev, tag)
+            return (tag_new, t - 1), tag
+
+        (tag0, _), path_tail = jax.lax.scan(
+            back, (last, jnp.full((), T - 1, jnp.int32)), backptrs,
+            reverse=True)  # path_tail[i] = tag_{i+1}; final carry = tag_0
+        paths = jnp.concatenate([tag0[None], path_tail], axis=0)  # [T, B]
+        return scores, jnp.swapaxes(paths, 0, 1).astype(jnp.int32)
+
+    return run_op("viterbi_decode", fn, [pot, trans, lens], n_outputs=2)
+
+
+class ViterbiDecoder(nn.Layer):
+    """reference: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = (transitions if isinstance(transitions, Tensor)
+                            else to_tensor(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -107,15 +107,17 @@ def custom_op(lib, symbol, *, name=None, platform="cpu", backward=None):
         fn = jax.ffi.ffi_call(target, out_aval)
         return fn(*values, **attrs)
 
-    def op(*tensors, out_shape=None, out_dtype=None, **attrs):
-        ts = [t if isinstance(t, Tensor) else to_tensor(t) for t in tensors]
-        shape = tuple(out_shape) if out_shape is not None else tuple(ts[0].shape)
-        dtype = out_dtype or ts[0]._value.dtype
-        out_aval = jax.ShapeDtypeStruct(shape, dtype)
+    # one stable callable per (out_aval, attrs) signature — a fresh
+    # custom_vjp object per call would defeat the eager dispatch cache
+    # (identity-keyed) and retrace every invocation
+    _fwd_cache: dict = {}
 
-        if backward is None:
-            return run_op(f"custom_{target}",
-                          lambda *vs: call_raw(vs, out_aval, attrs), ts)
+    def _get_fwd(out_aval, attrs):
+        key = (out_aval.shape, str(out_aval.dtype),
+               tuple(sorted(attrs.items())))
+        fwd = _fwd_cache.get(key)
+        if fwd is not None:
+            return fwd
 
         @jax.custom_vjp
         def fwd(*vs):
@@ -138,7 +140,25 @@ def custom_op(lib, symbol, *, name=None, platform="cpu", backward=None):
             return tuple(out)
 
         fwd.defvjp(fwd_res, bwd)
-        return run_op(f"custom_{target}", fwd, ts)
+        _fwd_cache[key] = fwd
+        return fwd
+
+    def op(*tensors, out_shape=None, out_dtype=None, **attrs):
+        ts = [t if isinstance(t, Tensor) else to_tensor(t) for t in tensors]
+        shape = tuple(out_shape) if out_shape is not None else tuple(ts[0].shape)
+        dtype = out_dtype or ts[0]._value.dtype
+        out_aval = jax.ShapeDtypeStruct(shape, dtype)
+
+        if backward is None:
+            key = (shape, str(dtype), tuple(sorted(attrs.items())))
+            fn = _fwd_cache.get(key)
+            if fn is None:
+                def fn(*vs, _aval=out_aval, _attrs=attrs):
+                    return call_raw(vs, _aval, _attrs)
+                _fwd_cache[key] = fn
+            return run_op(f"custom_{target}", fn, ts)
+
+        return run_op(f"custom_{target}", _get_fwd(out_aval, attrs), ts)
 
     op.__name__ = target
     return op

@@ -74,6 +74,39 @@ def test_engine_rejects_oversized_mesh():
     dist.env.set_global_mesh(None)
 
 
+def test_engine_predict_without_optimizer_and_partial_batch():
+    """Inference-only Engine (no optimizer/loss step build) + trailing
+    partial batches are not dropped."""
+    model, crit, _ = _setup()
+    engine = Engine(model=model, loss=crit, optimizer=None)
+    x, y = _data(10)  # 10 % 8 != 0
+    preds = engine.predict(test_data=(x, y), batch_size=8)
+    assert sum(p.shape[0] for p in preds) == 10
+    ev = engine.evaluate(valid_data=(x, y), batch_size=8)
+    assert np.isfinite(ev["loss"])
+    dist.env.set_global_mesh(None)
+
+
+def test_engine_save_carries_optimizer_state(tmp_path):
+    model, crit, optimizer = _setup()
+    engine = Engine(model=model, loss=crit, optimizer=optimizer)
+    x, y = _data(16)
+    engine.fit(train_data=(x, y), batch_size=8, epochs=2)
+    p = str(tmp_path / "ck")
+    engine.save(p)
+    from paddle_tpu.framework.io import load as fload
+
+    opt_sd = fload(p + ".pdopt")
+    param_states = [v for k, v in opt_sd.items() if k.startswith("param_")]
+    assert param_states, f"no param states in checkpoint: {list(opt_sd)}"
+    # Adam moments must be non-zero after real training steps
+    leaves = [np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+              for st in param_states for t in st.values()]
+    assert any(np.abs(l).max() > 0 for l in leaves), \
+        "optimizer checkpoint holds only init state"
+    dist.env.set_global_mesh(None)
+
+
 def test_engine_cost_model():
     from paddle_tpu.models import GPTForCausalLM, gpt3_tiny
 

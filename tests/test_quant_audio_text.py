@@ -1,0 +1,161 @@
+"""Quantization, audio, text subsystems (reference:
+python/paddle/quantization/ (PTQ/QAT/observers), python/paddle/audio/
+(functional + feature layers vs librosa-identical formulas),
+python/paddle/text/viterbi_decode.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# --------------------------------------------------------------------------- #
+# quantization
+# --------------------------------------------------------------------------- #
+
+
+class TestQuantization:
+    def _model(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+    def test_ptq_weight_only_int8(self):
+        from paddle_tpu.quantization import PTQ, QuantizedLinear
+
+        m = self._model()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32))
+        ref = m(x).numpy()
+        ptq = PTQ()
+        ptq.quantize(m)
+        _ = m(x)  # calibration pass
+        qm = ptq.convert(m)
+        layers = [s for _, s in qm.named_sublayers()]
+        assert any(isinstance(s, QuantizedLinear) for s in layers)
+        out = qm(x).numpy()
+        # int8 weight-only: small quantization error, same predictions-ish
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
+        # int8 storage really used
+        ql = [s for s in layers if isinstance(s, QuantizedLinear)][0]
+        assert str(ql.weight_quant._value.dtype) == "int8"
+        # calibration observed real activations -> nonzero act scale
+        assert ql.activation_scale > 0
+        scales = ptq.activation_scales()
+        assert scales and all(v > 0 for v in scales.values())
+
+    def test_quantize_weight_roundtrip(self):
+        from paddle_tpu.quantization import quantize_weight
+
+        w = paddle.to_tensor(
+            np.random.default_rng(1).normal(size=(8, 4)).astype(np.float32))
+        q, s = quantize_weight(w, axis=1)
+        deq = q.numpy().astype(np.float32) * s.numpy()
+        assert np.abs(deq - w.numpy()).max() < np.abs(w.numpy()).max() / 100
+
+    def test_qat_straight_through(self):
+        from paddle_tpu.quantization import QAT, fake_quant
+
+        x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32),
+                             stop_gradient=False)
+        y = fake_quant(x)
+        (y * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2.0)  # STE identity grad
+
+        m = self._model()
+        QAT().quantize(m)
+        xin = paddle.to_tensor(
+            np.random.default_rng(2).normal(size=(4, 16)).astype(np.float32))
+        out = m(xin)
+        out.sum().backward()
+        g = m[0].weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+
+
+# --------------------------------------------------------------------------- #
+# audio
+# --------------------------------------------------------------------------- #
+
+
+class TestAudio:
+    def test_mel_conversions(self):
+        from paddle_tpu.audio import functional as AF
+
+        assert abs(AF.mel_to_hz(AF.hz_to_mel(440.0)) - 440.0) < 1e-6
+        assert abs(AF.mel_to_hz(AF.hz_to_mel(4000.0)) - 4000.0) < 1e-3
+        assert abs(AF.hz_to_mel(0.0)) < 1e-9
+
+    def test_fbank_and_dct_shapes(self):
+        from paddle_tpu.audio import functional as AF
+
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert tuple(fb.shape) == (40, 257)
+        assert fb.numpy().min() >= 0
+        dct = AF.create_dct(13, 40)
+        assert tuple(dct.shape) == (40, 13)
+        # ortho DCT columns are orthonormal
+        d = dct.numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+    def test_spectrogram_parity_with_numpy_stft(self):
+        from paddle_tpu.audio import Spectrogram
+
+        sr, n_fft, hop = 8000, 256, 128
+        t = np.arange(sr // 4) / sr
+        sig = np.sin(2 * np.pi * 1000 * t).astype(np.float32)
+        spec = Spectrogram(n_fft=n_fft, hop_length=hop, center=False)(
+            paddle.to_tensor(sig[None]))
+        out = spec.numpy()[0]
+        # numpy reference stft (hann, power 2)
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+        n_frames = (len(sig) - n_fft) // hop + 1
+        frames = np.stack([sig[i * hop:i * hop + n_fft] * w
+                           for i in range(n_frames)])
+        ref = np.abs(np.fft.rfft(frames, axis=-1)).T ** 2
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+        # the 1 kHz bin dominates
+        assert abs(np.argmax(out.mean(-1)) - round(1000 * n_fft / sr)) <= 1
+
+    def test_logmel_and_mfcc_shapes(self):
+        from paddle_tpu.audio import LogMelSpectrogram, MFCC
+
+        sig = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(2, 4000)).astype(np.float32))
+        lm = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(sig)
+        assert lm.shape[0] == 2 and lm.shape[1] == 32
+        mf = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(sig)
+        assert mf.shape[1] == 13
+        assert np.isfinite(mf.numpy()).all()
+
+
+# --------------------------------------------------------------------------- #
+# text
+# --------------------------------------------------------------------------- #
+
+
+class TestText:
+    def test_viterbi_matches_bruteforce(self):
+        from paddle_tpu.text import ViterbiDecoder
+
+        rng = np.random.default_rng(0)
+        B, T, N = 2, 5, 4
+        pot = rng.normal(size=(B, T, N)).astype(np.float32)
+        trans = rng.normal(size=(N, N)).astype(np.float32)
+        dec = ViterbiDecoder(paddle.to_tensor(trans),
+                             include_bos_eos_tag=False)
+        scores, paths = dec(paddle.to_tensor(pot))
+
+        # brute force over all N^T paths
+        import itertools
+
+        for b in range(B):
+            best, best_path = -np.inf, None
+            for path in itertools.product(range(N), repeat=T):
+                s = pot[b, 0, path[0]]
+                for t in range(1, T):
+                    s += trans[path[t - 1], path[t]] + pot[b, t, path[t]]
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-5)
+            assert tuple(paths.numpy()[b]) == best_path

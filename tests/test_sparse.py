@@ -77,6 +77,15 @@ def test_relu_and_softmax():
     np.testing.assert_allclose(sm2, e / e.sum(), rtol=1e-5)
 
 
+def test_softmax_3d_lanes_independent():
+    """ndim > 2: entries normalize per (batch, row) lane, never across."""
+    idx = [[0, 0], [0, 1], [0, 0]]  # two different rows of batch 0
+    s = sparse.sparse_coo_tensor(idx, [1.0, 5.0], shape=[2, 2, 3])
+    sm = sparse.nn.functional.softmax(s)
+    # each lane has a single entry -> softmax = 1, NOT mixed across rows
+    np.testing.assert_allclose(sm.values().numpy(), [1.0, 1.0])
+
+
 def test_transpose():
     t = sparse.transpose(_coo(), [1, 0])
     np.testing.assert_allclose(t.to_dense().numpy(),
