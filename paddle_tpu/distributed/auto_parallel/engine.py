@@ -138,13 +138,19 @@ class Engine:
             self.prepare()
         elif self._mesh is None:
             self._mesh = self._build_mesh()
-        for i, (x, y) in enumerate(self._batches(valid_data, batch_size)):
-            if steps is not None and i >= steps:
-                break
-            if self._optimizer is not None:
-                losses.append(float(self._step.evaluate(x, y)))
-            else:
-                losses.append(float(self._loss(self._model(x), y)))
+        was_training = self._model.training
+        self._model.eval()  # dropout/BN must be in eval mode either path
+        try:
+            for i, (x, y) in enumerate(self._batches(valid_data, batch_size)):
+                if steps is not None and i >= steps:
+                    break
+                if self._optimizer is not None:
+                    losses.append(float(self._step.evaluate(x, y)))
+                else:
+                    losses.append(float(self._loss(self._model(x), y)))
+        finally:
+            if was_training:
+                self._model.train()
         return {"loss": float(np.mean(losses)) if losses else None}
 
     def predict(self, test_data=None, batch_size=1, steps=None, **kw):

@@ -41,3 +41,19 @@ __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "llama_tiny", "llama_7b", "llama_13b",
 ]
+
+from .bert import (  # noqa: F401,E402
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+    bert_base,
+    bert_tiny,
+)
+from .unet import UNetConfig, UNetModel, unet_tiny  # noqa: F401,E402
+__all__ += [
+    "BertConfig", "BertModel", "BertForPretraining",
+    "BertForSequenceClassification", "BertPretrainingCriterion",
+    "bert_base", "bert_tiny", "UNetConfig", "UNetModel", "unet_tiny",
+]

@@ -90,6 +90,8 @@ class QuantConfig:
         self._types.extend(t for t in types if t not in self._types)
         if weight is not None:
             self.weight = weight
+        if activation is not None:
+            self.activation = activation
         return self
 
 
@@ -158,7 +160,13 @@ class PTQ:
 
     def convert(self, model, inplace=False, bits=8):
         """Swap each observed Linear for its QuantizedLinear carrying the
-        calibrated activation scale."""
+        calibrated activation scale. Must be the model that quantize()
+        instrumented — converting a different object would silently mutate
+        the recorded one."""
+        if self._observed and self._observed[0][0] is not model:
+            raise ValueError(
+                "convert() must receive the same model instance that "
+                "quantize() instrumented")
         for owner, name, sub, obs in self._observed:
             sub.forward = sub._ptq_orig_forward  # unhook the observer
             parts = name.split(".")

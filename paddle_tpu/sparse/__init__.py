@@ -237,10 +237,13 @@ class _SparseNN:
             bx = _as_bcoo(x)
             if axis not in (-1, len(bx.shape) - 1):
                 raise NotImplementedError("sparse softmax: last axis only")
-            lead = bx.indices[:, :-1].astype(jnp.int64)
+            lead = bx.indices[:, :-1].astype(jnp.int32)
             strides = np.cumprod([1] + list(bx.shape[:-1][::-1]))[::-1][1:]
-            keys = (lead * jnp.asarray(strides.copy(), jnp.int64)).sum(axis=1)
             n_lanes = int(np.prod(bx.shape[:-1]))
+            if n_lanes > np.iinfo(np.int32).max:
+                raise NotImplementedError(
+                    "sparse softmax: leading-dim product exceeds int32 lanes")
+            keys = (lead * jnp.asarray(strides.copy(), jnp.int32)).sum(axis=1)
             mx = jnp.full(n_lanes, -jnp.inf).at[keys].max(bx.data)
             e = jnp.exp(bx.data - mx[keys])
             denom = jnp.zeros(n_lanes).at[keys].add(e)

@@ -112,9 +112,21 @@ def custom_op(lib, symbol, *, name=None, platform="cpu", backward=None):
     # (identity-keyed) and retrace every invocation
     _fwd_cache: dict = {}
 
+    def _attr_key(attrs):
+        # ndarray attrs are legal ffi_call inputs but unhashable; key them
+        # by content
+        parts = []
+        for k in sorted(attrs):
+            v = attrs[k]
+            if hasattr(v, "tobytes"):
+                parts.append((k, v.tobytes(), getattr(v, "shape", None),
+                              str(getattr(v, "dtype", type(v)))))
+            else:
+                parts.append((k, v))
+        return tuple(parts)
+
     def _get_fwd(out_aval, attrs):
-        key = (out_aval.shape, str(out_aval.dtype),
-               tuple(sorted(attrs.items())))
+        key = (out_aval.shape, str(out_aval.dtype), _attr_key(attrs))
         fwd = _fwd_cache.get(key)
         if fwd is not None:
             return fwd
@@ -150,7 +162,7 @@ def custom_op(lib, symbol, *, name=None, platform="cpu", backward=None):
         out_aval = jax.ShapeDtypeStruct(shape, dtype)
 
         if backward is None:
-            key = (shape, str(dtype), tuple(sorted(attrs.items())))
+            key = (shape, str(dtype), _attr_key(attrs))
             fn = _fwd_cache.get(key)
             if fn is None:
                 def fn(*vs, _aval=out_aval, _attrs=attrs):
