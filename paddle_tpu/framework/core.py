@@ -397,18 +397,36 @@ class Tensor:
         return self
 
     def to(self, *args, **kwargs):
-        # paddle Tensor.to(device|dtype)
+        """paddle Tensor.to(device|dtype|tensor). Device strings are accepted
+        and are no-ops (single-controller placement is owned by jax); an
+        argument that is neither a device string, a dtype, nor a Tensor is an
+        error — a silently-ignored typo here poisons whole ports."""
+        _DEVICES = ("cpu", "gpu", "tpu", "xpu", "npu", "custom")
+        out = self
         for a in list(args) + list(kwargs.values()):
+            if a is None:
+                continue
+            if isinstance(a, Tensor):
+                out = out.astype(a._value.dtype)
+                continue
+            if isinstance(a, str) and a.split(":")[0].lower() in _DEVICES:
+                continue  # device placement: no-op by design
+            if type(a).__name__.endswith("Place"):
+                continue  # CPUPlace/TPUPlace/CUDAPlace objects: placement no-op
+            if isinstance(a, bool):
+                continue  # blocking= flag
             try:
                 nd = dtype_mod.convert_dtype(a)
             except TypeError:
-                continue
-            if nd is not None and not isinstance(a, (Tensor,)):
-                try:
-                    return self.astype(nd)
-                except Exception:
-                    continue
-        return self
+                raise ValueError(
+                    f"Tensor.to(): cannot interpret {a!r} as a device, "
+                    f"dtype, or Tensor")
+            if nd is None:
+                raise ValueError(
+                    f"Tensor.to(): cannot interpret {a!r} as a device, "
+                    f"dtype, or Tensor")
+            out = out.astype(nd)
+        return out
 
     # -- autograd ---------------------------------------------------------- #
 

@@ -401,8 +401,13 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     """
     import math
 
-    if quant_method not in ("None", None, "none"):
-        raise NotImplementedError("fused_moe quantized paths are not supported on TPU yet")
+    weight_only = quant_method == "weight_only_int8"
+    if quant_method not in ("None", None, "none", "weight_only_int8"):
+        raise NotImplementedError(
+            f"fused_moe quant_method {quant_method!r} is not supported on "
+            f"TPU (weight_only_int8 is)")
+    if weight_only and (ffn1_scale is None or ffn2_scale is None):
+        raise ValueError("weight_only_int8 requires ffn1_scale and ffn2_scale")
     if group_moe:
         raise NotImplementedError("fused_moe group_moe routing is not supported on TPU yet")
 
@@ -411,8 +416,16 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     has_b1 = ffn1_bias is not None
     has_b2 = ffn2_bias is not None
 
-    def fn(xv, gw, w1, w2, *biases):
-        bi = iter(biases)
+    def fn(xv, gw, w1, w2, *rest):
+        bi = iter(rest)
+        if weight_only:
+            # int8 expert weights dequantize per expert/out-channel; the
+            # scale multiply folds into the expert GEMMs (reference:
+            # cutlass weight-only grouped GEMM)
+            s1 = next(bi)
+            s2 = next(bi)
+            w1 = w1.astype(xv.dtype) * s1.reshape(w1.shape[0], 1, -1).astype(xv.dtype)
+            w2 = w2.astype(xv.dtype) * s2.reshape(w2.shape[0], 1, -1).astype(xv.dtype)
         b1 = next(bi) if has_b1 else None
         b2 = next(bi) if has_b2 else None
         shape = xv.shape
@@ -443,6 +456,8 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         return out.reshape(shape)
 
     args = [x, gate_weight, ffn1_weight, ffn2_weight]
+    if weight_only:
+        args += [ffn1_scale, ffn2_scale]
     if has_b1:
         args.append(ffn1_bias)
     if has_b2:
@@ -577,20 +592,35 @@ def block_multihead_attention(
     from ....nn.functional._attn_math import masked_attention
 
     if any(v is not None for v in (pre_key_cache, pre_value_cache,
-                                   cache_k_quant_scales, qkv_out_scale,
-                                   out_shift, out_smooth)):
-        raise NotImplementedError("block_multihead_attention quant/pre-cache "
-                                  "paths are not supported on TPU")
+                                   qkv_out_scale, out_shift, out_smooth)):
+        raise NotImplementedError("block_multihead_attention pre-cache/"
+                                  "activation-quant paths are not supported "
+                                  "on TPU")
     assert block_tables is not None, "block_tables is required"
+
+    _scales = (cache_k_quant_scales, cache_v_quant_scales,
+               cache_k_dequant_scales, cache_v_dequant_scales)
+    cache_quant = any(s is not None for s in _scales)
+    if cache_quant and any(s is None for s in _scales):
+        # a partially-supplied set must not silently disable quantization
+        raise ValueError(
+            "int8 cache quant needs all four cache_{k,v}_{quant,dequant}"
+            "_scales")
 
     ins = [_t(qkv), _t(key_cache), _t(value_cache), _t(seq_lens_encoder),
            _t(seq_lens_decoder), _t(block_tables)]
+    if cache_quant:
+        ins += [_t(cache_k_quant_scales), _t(cache_v_quant_scales),
+                _t(cache_k_dequant_scales), _t(cache_v_dequant_scales)]
     has_bias = qkv_bias is not None
     if has_bias:
         ins.append(_t(qkv_bias))
 
     def fn(qkv_v, kc, vc, enc_lens, dec_lens, tables, *rest):
-        b = rest[0] if has_bias else None
+        ri = iter(rest)
+        if cache_quant:
+            kqs, vqs, kdqs, vdqs = (next(ri) for _ in range(4))
+        b = next(ri) if has_bias else None
         B, S = qkv_v.shape[0], qkv_v.shape[1]
         n_blocks, Hkv, bs, D = kc.shape
         HD3 = qkv_v.shape[-1]
@@ -617,11 +647,18 @@ def block_multihead_attention(
         flat_slot = slot.reshape(-1)
         kn = k_new.reshape(B * S, Hkv, D)
         vn = v_new.reshape(B * S, Hkv, D)
+        if cache_quant:
+            # int8 cache (reference CacheKVInt8 path): per-kv-head symmetric
+            # scales; new K/V quantize on write, pages dequantize on read
+            kn = jnp.clip(jnp.round(
+                kn * kqs.reshape(1, Hkv, 1)), -128, 127)
+            vn = jnp.clip(jnp.round(
+                vn * vqs.reshape(1, Hkv, 1)), -128, 127)
         kc = kc.at[flat_pages, :, flat_slot].set(kn.astype(kc.dtype), mode="drop")
         vc = vc.at[flat_pages, :, flat_slot].set(vn.astype(vc.dtype), mode="drop")
 
         total = offs + jnp.where(enc_lens > 0, enc_lens, 1)
-        if S == 1 and _pallas_decode_on():
+        if S == 1 and not cache_quant and _pallas_decode_on():
             # hot decode loop: paged Pallas kernel — block table resolved in
             # the BlockSpec index_map, no gathered cache copy materialized
             from ....ops.pallas.decode_attention import paged_decode_attention
@@ -636,6 +673,9 @@ def block_multihead_attention(
         gv = vc[jnp.where(tables >= 0, tables, 0)]
         gk = jnp.moveaxis(gk, 2, 3).reshape(B, S_max, Hkv, D)
         gv = jnp.moveaxis(gv, 2, 3).reshape(B, S_max, Hkv, D)
+        if cache_quant:
+            gk = gk.astype(q.dtype) * kdqs.reshape(1, 1, Hkv, 1).astype(q.dtype)
+            gv = gv.astype(q.dtype) * vdqs.reshape(1, 1, Hkv, 1).astype(q.dtype)
         # causal w.r.t. absolute positions; also clip to valid cache range
         qpos = pos                                              # [B, S]
         kpos = jnp.arange(S_max)[None, :]

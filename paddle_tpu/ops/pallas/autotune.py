@@ -1,0 +1,132 @@
+"""Kernel block-size autotuning (reference: paddle/phi/kernels/autotune/ —
+cache.h AutoTuneCache keyed per kernel+shape, auto_tune_base.h measuring
+candidate configs at first use).
+
+TPU formulation: the tunable is the Pallas block shape (bq, bk). Enabled via
+PADDLE_TPU_AUTOTUNE=1, the first call of a kernel signature measures each
+legal candidate with a compiled micro-run and caches the winner — in-process
+and on disk (~/.cache/paddle_tpu_autotune.json, keyed by device kind) so
+later processes skip the sweep. Disabled (default) or under the interpreter
+it returns the caller's default immediately; measurement failures fall back
+the same way, so tuning can never break a run."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["autotune_enabled", "pick_block_sizes", "cache_path",
+           "clear_cache"]
+
+_lock = threading.Lock()
+_memory: dict = {}
+_disk_loaded = [False]
+
+
+def autotune_enabled() -> bool:
+    from . import interpret_mode
+
+    return (os.environ.get("PADDLE_TPU_AUTOTUNE", "0") == "1"
+            and not interpret_mode())
+
+
+def cache_path():
+    d = os.environ.get("PADDLE_TPU_AUTOTUNE_DIR",
+                       os.path.join(os.path.expanduser("~"), ".cache"))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "paddle_tpu_autotune.json")
+
+
+def _device_kind():
+    try:
+        import jax
+
+        return getattr(jax.devices()[0], "device_kind", "unknown")
+    except Exception:
+        return "unknown"
+
+
+def _load_disk():
+    if _disk_loaded[0]:
+        return
+    _disk_loaded[0] = True
+    try:
+        with open(cache_path()) as f:
+            _memory.update(json.load(f))
+    except Exception:
+        pass
+
+
+def _store_disk():
+    try:
+        with open(cache_path(), "w") as f:
+            json.dump(_memory, f)
+    except Exception:
+        pass
+
+
+def clear_cache():
+    with _lock:
+        _memory.clear()
+        _disk_loaded[0] = False
+        try:
+            os.remove(cache_path())
+        except OSError:
+            pass
+
+
+def _candidates(sq, skv, default):
+    """Legal (bq, bk) choices: block divides (or covers) the padded seq,
+    bounded so the f32 logits tile [bq, bk] stays well under VMEM."""
+    cands = {default}
+    for bq in (128, 256):
+        for bk in (128, 256, 512):
+            if bq * bk > 256 * 512:
+                continue
+            if sq >= bq and skv >= bk:
+                cands.add((bq, bk))
+    return sorted(cands)
+
+
+def pick_block_sizes(kernel_name, sq, skv, default, run_with, reps=3,
+                     allow_measure=True, signature=()):
+    """Return the best (bq, bk) for this signature.
+
+    `run_with(bq, bk)` must execute one full kernel invocation (compiling on
+    first use) and block on the result; it is measured `reps` times per
+    candidate. Key: (kernel, device kind, sq, skv, *signature) — pass every
+    workload dimension the timing depends on (batch, heads, head_dim, dtype,
+    causal) in `signature` so a winner tuned for one model is never reused
+    for a different-shaped workload. With allow_measure=False (inputs are
+    tracers — measurement impossible inside a jit trace) only the cache is
+    consulted."""
+    if not autotune_enabled():
+        return default
+    sig = "|".join(str(s) for s in signature)
+    key = f"{kernel_name}|{_device_kind()}|{sq}|{skv}|{sig}"
+    with _lock:
+        _load_disk()
+        hit = _memory.get(key)
+    if hit is not None:
+        return tuple(hit)
+    if not allow_measure:
+        return default
+
+    best, best_t = default, float("inf")
+    for bq, bk in _candidates(sq, skv, default):
+        try:
+            run_with(bq, bk)  # compile + warm up
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                run_with(bq, bk)
+            dt = (time.perf_counter() - t0) / reps
+        except Exception:
+            continue  # illegal tiling / OOM candidate: skip
+        if dt < best_t:
+            best, best_t = (bq, bk), dt
+    with _lock:
+        _memory[key] = list(best)
+        _store_disk()
+    return best

@@ -124,10 +124,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, causal, sq, skv):
+def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None):
     B, H, Sqp, D = q.shape
     _, Hkv, Skvp, _ = k.shape
-    bq, bk = _block_sizes(Sqp, Skvp)
+    if bq is None or bk is None:
+        bq, bk = _block_sizes(Sqp, Skvp)
     nq = Sqp // bq
     nk = Skvp // bk
     group = H // Hkv
@@ -353,14 +354,36 @@ def _flash(q, k, v, causal, scale):
     return out
 
 
+def _tuned_blocks(q, k, v, causal, scale):
+    """Forward block sizes, autotuned per (seq, kv-seq) signature when
+    PADDLE_TPU_AUTOTUNE=1 (reference: phi/kernels/autotune cache)."""
+    from .autotune import autotune_enabled, pick_block_sizes
+
+    sq, skv = q.shape[2], k.shape[2]
+    default = _block_sizes(sq, skv)
+    if not autotune_enabled():
+        return default
+
+    def run_with(bq, bk):
+        out, _ = _fwd(_pad_seq(q, bq), _pad_seq(k, bk), _pad_seq(v, bk),
+                      scale, causal, sq, skv, bq=bq, bk=bk)
+        jax.block_until_ready(out)
+
+    concrete = not any(isinstance(x, jax.core.Tracer) for x in (q, k, v))
+    B, H, _, D = q.shape
+    return pick_block_sizes(
+        "flash_fwd", sq, skv, default, run_with, allow_measure=concrete,
+        signature=(B, H, k.shape[1], D, str(q.dtype), bool(causal)))
+
+
 def _flash_fwd_res(q, k, v, causal, scale):
     B, H, Sq, D = q.shape
     Skv = k.shape[2]
-    bq, bk = _block_sizes(Sq, Skv)
+    bq, bk = _tuned_blocks(q, k, v, causal, scale)
     qp = _pad_seq(q, bq)
     kp = _pad_seq(k, bk)
     vp = _pad_seq(v, bk)
-    out, lse = _fwd(qp, kp, vp, scale, causal, Sq, Skv)
+    out, lse = _fwd(qp, kp, vp, scale, causal, Sq, Skv, bq=bq, bk=bk)
     return out[:, :, :Sq], (qp, kp, vp, out, lse)
 
 

@@ -133,6 +133,42 @@ class TestBlockAttention:
         ref_d = np.einsum("bhst,bthd->bshd", _softmax(logits), vr).reshape(B, 1, Hq * D)
         np.testing.assert_allclose(out_d.numpy(), ref_d, rtol=1e-5, atol=1e-5)
 
+    def test_int8_kv_cache_quant(self):
+        """int8 cache path: quantize-on-write, dequantize-on-read tracks the
+        fp32 cache within quantization error (reference CacheKVInt8)."""
+        rng = np.random.RandomState(3)
+        B, Hq, Hkv, D, bs = 2, 4, 2, 8, 4
+        S = 5
+        qkv = rng.randn(B, S, (Hq + 2 * Hkv) * D).astype(np.float32)
+        tables = np.array([[0, 1, -1, -1], [2, 3, -1, -1]], np.int32)
+        args = dict(block_tables=paddle.to_tensor(tables), block_size=bs)
+        enc = paddle.to_tensor(np.full((B,), S, np.int32))
+        dec = paddle.to_tensor(np.zeros((B,), np.int32))
+        this = paddle.to_tensor(np.full((B,), S, np.int32))
+
+        # fp32 reference
+        kc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        vc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        ref, _, _, _ = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), kc, vc, enc, dec, this, **args)
+
+        # int8 cache: per-kv-head scales sized to the data range
+        amax = np.abs(qkv).max()
+        qs = np.full((Hkv,), 127.0 / amax, np.float32)
+        dqs = (1.0 / qs).astype(np.float32)
+        kc8 = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.int8))
+        vc8 = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.int8))
+        out, _, kc8b, _ = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), kc8, vc8, enc, dec, this,
+            cache_k_quant_scales=paddle.to_tensor(qs),
+            cache_v_quant_scales=paddle.to_tensor(qs),
+            cache_k_dequant_scales=paddle.to_tensor(dqs),
+            cache_v_dequant_scales=paddle.to_tensor(dqs), **args)
+        assert str(kc8b.numpy().dtype) == "int8"
+        assert np.abs(kc8b.numpy()).max() > 0  # writes actually quantized
+        err = np.abs(out.numpy() - ref.numpy()).max()
+        assert err < 0.05 * np.abs(ref.numpy()).max() + 1e-2, err
+
     def test_blha_get_max_len(self):
         e, d = IF.blha_get_max_len(
             paddle.to_tensor(np.array([3, 9, 1], np.int32)),

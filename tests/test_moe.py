@@ -182,6 +182,37 @@ class TestFusedMoE:
                 ref[t] += w[j] * (h @ w2[e])
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
+    def test_fused_moe_weight_only_int8(self):
+        """weight_only_int8: int8 expert weights + per-out-channel scales
+        reproduce the fp32 MoE within quantization error (reference cutlass
+        weight-only grouped GEMM path)."""
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(7)
+        E, M, H, T = 4, 8, 16, 12
+        x = rng.randn(T, M).astype(np.float32) * 0.5
+        gw = rng.randn(M, E).astype(np.float32) * 0.1
+        w1 = rng.randn(E, M, 2 * H).astype(np.float32) * 0.1
+        w2 = rng.randn(E, H, M).astype(np.float32) * 0.1
+
+        def quant(w):
+            scale = np.abs(w).max(axis=1) / 127.0  # [E, out]
+            q = np.clip(np.round(w / scale[:, None, :]), -128, 127).astype(np.int8)
+            return q, scale.astype(np.float32)
+
+        q1, s1 = quant(w1)
+        q2, s2 = quant(w2)
+        ref = IF.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                           paddle.to_tensor(w1), paddle.to_tensor(w2),
+                           moe_topk=2).numpy()
+        got = IF.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                           paddle.to_tensor(q1), paddle.to_tensor(q2),
+                           ffn1_scale=paddle.to_tensor(s1),
+                           ffn2_scale=paddle.to_tensor(s2),
+                           quant_method="weight_only_int8",
+                           moe_topk=2).numpy()
+        assert np.abs(got - ref).max() < 0.05 * np.abs(ref).max() + 1e-3
+
 
 class TestGlobalScatterGather:
     def test_round_trip(self):

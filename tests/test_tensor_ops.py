@@ -312,3 +312,20 @@ class TestReviewRegressions:
     def test_argmax_dtype_honored(self):
         x = paddle.to_tensor([[1.0, 5.0]])
         assert paddle.argmax(x, axis=1, dtype="int32").dtype == np.int32
+
+
+class TestTensorTo:
+    def test_to_device_dtype_tensor(self):
+        """Tensor.to accepts device strings (placement no-op), dtypes, and
+        Tensors; anything else raises instead of silently returning self
+        (reference Tensor.to, python/paddle/base/dygraph/tensor_patch_methods.py)."""
+        import pytest
+
+        t = paddle.to_tensor(np.ones(3, np.float32))
+        assert "float16" in str(t.to("float16")._value.dtype)
+        assert t.to("cpu")._value.dtype == t._value.dtype
+        assert t.to("gpu:0") is not None  # device strings are accepted
+        assert "int32" in str(
+            t.to(paddle.to_tensor(np.ones(1, np.int32)))._value.dtype)
+        with pytest.raises(ValueError, match="cannot interpret"):
+            t.to("floaty32")
