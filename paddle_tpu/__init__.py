@@ -121,3 +121,4 @@ from . import quantization  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import onnx  # noqa: E402
+from . import signal  # noqa: E402

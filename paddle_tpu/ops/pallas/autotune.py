@@ -60,9 +60,22 @@ def _load_disk():
 
 
 def _store_disk():
+    """Merge-then-atomic-rename: concurrent tuners must not clobber each
+    other's winners, and an interrupt must not truncate the shared file."""
     try:
-        with open(cache_path(), "w") as f:
-            json.dump(_memory, f)
+        path = cache_path()
+        merged = {}
+        try:
+            with open(path) as f:
+                merged.update(json.load(f))
+        except Exception:
+            pass
+        merged.update(_memory)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(merged, f)
+        os.replace(tmp, path)
+        _memory.update(merged)
     except Exception:
         pass
 

@@ -394,7 +394,6 @@ def _flash_vjp_fwd(q, k, v, causal, scale):
 
 def _flash_vjp_bwd(causal, scale, saved, dout):
     (qp, kp, vp, outp, lse), sq, skv = saved
-    bq, _ = _block_sizes(qp.shape[2], kp.shape[2])
     dop = jnp.pad(dout, ((0, 0), (0, 0), (0, qp.shape[2] - sq), (0, 0)))
     dq, dk, dv = _bwd(scale, causal, sq, skv, (qp, kp, vp, outp, lse), dop)
     return dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv]

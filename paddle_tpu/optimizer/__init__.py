@@ -9,13 +9,19 @@ from .optimizer import (
     Adamax,
     AdamW,
     Lamb,
+    NAdam,
+    RAdam,
+    Rprop,
+    ASGD,
     Lars,
     Momentum,
     Optimizer,
     RMSProp,
 )
+from .lbfgs import LBFGS
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
-    "Adadelta", "RMSProp", "Lamb", "Lars", "lr",
+    "Adadelta", "RMSProp", "Lamb", "Lars", "NAdam", "RAdam", "Rprop", "ASGD",
+    "LBFGS", "lr",
 ]

@@ -1,0 +1,228 @@
+"""L-BFGS optimizer (reference: python/paddle/optimizer/lbfgs.py —
+closure-based step(), two-loop recursion, strong-Wolfe line search).
+
+TPU note: L-BFGS is a full-batch method driven by host-side control flow
+(line-search iterations re-evaluate the closure), so the implementation is
+eager by design — each closure call is itself a compiled forward/backward."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS(Optimizer):
+    """reference lbfgs.py LBFGS. Usage:
+
+        def closure():
+            opt.clear_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if grad_clip is not None:
+            raise ValueError(
+                "LBFGS does not support grad_clip: clipping the line-search "
+                "gradients breaks the Wolfe conditions (the reference LBFGS "
+                "has no grad_clip either)")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.line_search_fn = line_search_fn
+        self._s: list = []   # param deltas
+        self._y: list = []   # grad deltas
+        self._prev_flat_grad = None
+
+    # ------------------------------------------------------------------ #
+
+    def _params(self):
+        flat = []
+        for p in self._parameter_list or []:
+            if isinstance(p, dict):
+                flat.extend(p["params"])
+            else:
+                flat.append(p)
+        return [p for p in flat if not p.stop_gradient]
+
+    def _gather_flat_grad(self, params):
+        gs = []
+        wd = self._decay_coeff()
+        for p in params:
+            g = p.grad._value if p.grad is not None else jnp.zeros_like(p._value)
+            g = g.astype(jnp.float32)
+            if wd:  # L2 decay folds into the objective's gradient
+                g = g + wd * p._value.astype(jnp.float32)
+            gs.append(jnp.ravel(g))
+        return jnp.concatenate(gs)
+
+    def _gather_flat_params(self, params):
+        return jnp.concatenate(
+            [jnp.ravel(p._value.astype(jnp.float32)) for p in params])
+
+    def _set_flat_params(self, params, flat):
+        off = 0
+        for p in params:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._value = flat[off:off + n].reshape(p._value.shape).astype(
+                p._value.dtype)
+            off += n
+
+    def _direction(self, flat_grad):
+        """Two-loop recursion over the (s, y) history."""
+        q = -flat_grad
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / float(jnp.dot(y, s))
+            a = rho * float(jnp.dot(s, q))
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = float(jnp.dot(s, y) / jnp.dot(y, y))
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.dot(y, q))
+            q = q + (a - b) * s
+        return q
+
+    @no_grad()
+    def step(self, closure):
+        """One L-BFGS outer step; `closure` re-evaluates loss + grads."""
+        params = self._params()
+        with _grad_enabled():
+            loss = closure()
+        loss_val = float(loss.numpy())
+        flat_grad = self._gather_flat_grad(params)
+        n_evals = 1
+        lr = self.get_lr()
+
+        for _it in range(self.max_iter):
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tol_grad:
+                break
+            d = self._direction(flat_grad)
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -1e-16:  # not a descent direction: reset history
+                self._s.clear()
+                self._y.clear()
+                d = -flat_grad
+                gtd = float(jnp.dot(flat_grad, d))
+            t = lr if (self._s or _it > 0) else min(
+                1.0, 1.0 / max(float(jnp.sum(jnp.abs(flat_grad))), 1e-12)) * lr
+
+            x0 = self._gather_flat_params(params)
+
+            def eval_at(step_size):
+                self._set_flat_params(params, x0 + step_size * d)
+                with _grad_enabled():
+                    ls = closure()
+                return float(ls.numpy()), self._gather_flat_grad(params)
+
+            if self.line_search_fn == "strong_wolfe":
+                t, new_loss, new_grad, evals = _strong_wolfe(
+                    eval_at, t, loss_val, flat_grad, d, gtd)
+                n_evals += evals
+            else:
+                new_loss, new_grad = eval_at(t)
+                n_evals += 1
+
+            s = t * d
+            y = new_grad - flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+
+            if abs(new_loss - loss_val) < self.tol_change:
+                loss_val, flat_grad = new_loss, new_grad
+                break
+            loss_val, flat_grad = new_loss, new_grad
+            if n_evals >= self.max_eval:
+                break
+
+        self._step_count += 1
+        return Tensor(jnp.asarray(loss_val, jnp.float32))
+
+
+def _strong_wolfe(eval_at, t, f0, g0, d, gtd0, c1=1e-4, c2=0.9, max_ls=10):
+    """Bracketing strong-Wolfe line search (reference lbfgs.py
+    _strong_wolfe)."""
+    f_prev, t_prev = f0, 0.0
+    evals = 0
+    f_new, g_new = eval_at(t)
+    evals += 1
+    for i in range(max_ls):
+        gtd_new = float(jnp.dot(g_new, d))
+        if f_new > f0 + c1 * t * gtd0 or (i > 0 and f_new >= f_prev):
+            return _zoom(eval_at, t_prev, t, f_prev, f_new, f0, gtd0, d,
+                         c1, c2, evals)
+        if abs(gtd_new) <= -c2 * gtd0:
+            return t, f_new, g_new, evals
+        if gtd_new >= 0:
+            return _zoom(eval_at, t, t_prev, f_new, f_prev, f0, gtd0, d,
+                         c1, c2, evals)
+        t_prev, f_prev = t, f_new
+        t = t * 2.0
+        f_new, g_new = eval_at(t)
+        evals += 1
+    return t, f_new, g_new, evals
+
+
+def _zoom(eval_at, lo, hi, f_lo, f_hi, f0, gtd0, d, c1, c2, evals,
+          max_zoom=10):
+    t = lo
+    f_new, g_new = f_lo, None
+    for _ in range(max_zoom):
+        t = 0.5 * (lo + hi)
+        f_new, g_new = eval_at(t)
+        evals += 1
+        if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+            hi, f_hi = t, f_new
+        else:
+            gtd_new = float(jnp.dot(g_new, d))
+            if abs(gtd_new) <= -c2 * gtd0:
+                break
+            if gtd_new * (hi - lo) >= 0:
+                hi, f_hi = lo, f_lo
+            lo, f_lo = t, f_new
+    if g_new is None:
+        f_new, g_new = eval_at(t)
+        evals += 1
+    return t, f_new, g_new, evals
+
+
+class _grad_enabled:
+    """Re-enable autograd inside step()'s no_grad scope for closure calls."""
+
+    def __enter__(self):
+        from ..framework.core import is_grad_enabled, set_grad_enabled
+
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        from ..framework.core import set_grad_enabled
+
+        set_grad_enabled(self._prev)
+        return False

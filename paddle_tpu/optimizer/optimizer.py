@@ -15,6 +15,7 @@ copy in the state (reference: master-weight support across optimizer kernels).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -420,3 +421,125 @@ class Lars(Momentum):
         g = g + wd * p
         v = self._momentum * state["velocity"].astype(g.dtype) + local_lr * g
         return p - lr * v, {"velocity": v}
+
+
+class NAdam(Optimizer):
+    """reference: python/paddle/optimizer/nadam.py (Nesterov-momentum Adam)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def init_state(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+                "mu_prod": jnp.ones((), jnp.float32)}
+
+    def update(self, p, g, state, lr, ctx):
+        b1, b2, eps, psi = self._beta1, self._beta2, self._epsilon, self._psi
+        t = jnp.asarray(ctx["step"], jnp.float32)
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        mu_prod = state["mu_prod"] * mu_t
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g / (1 - mu_prod))
+        v_hat = v / (1 - b2 ** t)
+        return (p - lr * m_hat / (jnp.sqrt(v_hat) + eps),
+                {"m": m, "v": v, "mu_prod": mu_prod})
+
+
+class RAdam(Optimizer):
+    """reference: python/paddle/optimizer/radam.py (rectified Adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def update(self, p, g, state, lr, ctx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = jnp.asarray(ctx["step"], jnp.float32)
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2 ** t / (1 - b2 ** t)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num / r_den, 0.0))
+        v_hat = jnp.sqrt(v / (1 - b2 ** t))
+        adaptive = rect * m_hat / (v_hat + eps)
+        sgd_like = m_hat
+        return (p - lr * jnp.where(rho_t > 5.0, adaptive, sgd_like),
+                {"m": m, "v": v})
+
+
+class Rprop(Optimizer):
+    """reference: python/paddle/optimizer/rprop.py (sign-based resilient
+    propagation; full-batch method)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name=name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def init_state(self, p):
+        return {"prev_g": jnp.zeros_like(p),
+                "step_size": jnp.full_like(p, self.get_lr())}
+
+    def update(self, p, g, state, lr, ctx):
+        sign = jnp.sign(g * state["prev_g"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step = jnp.clip(state["step_size"] * factor, self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)  # backtrack: skip update
+        return (p - step * jnp.sign(g_eff),
+                {"prev_g": g_eff, "step_size": step})
+
+
+class ASGD(Optimizer):
+    """reference: python/paddle/optimizer/asgd.py (averaged SGD)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision, name=name)
+        self._n = max(int(batch_num), 1)
+
+    def init_state(self, p):
+        # under multi_precision the update runs on the f32 master weights,
+        # so the grad history must be f32 too (dynamic_update_slice is
+        # dtype-strict)
+        dt = (jnp.float32 if self._multi_precision
+              and p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype)
+        return {"d": jnp.zeros(p.shape, dt),
+                "ys": jnp.zeros((self._n,) + p.shape, dt),
+                "idx": jnp.zeros((), jnp.int32)}
+
+    def update(self, p, g, state, lr, ctx):
+        wd = ctx["weight_decay"]
+        if wd:
+            g = g + wd * p
+        g = g.astype(state["ys"].dtype)
+        i = state["idx"] % self._n
+        old = jax.lax.dynamic_index_in_dim(state["ys"], i, 0, keepdims=False)
+        d = state["d"] - old + g
+        ys = jax.lax.dynamic_update_index_in_dim(state["ys"], g, i, 0)
+        return (p - lr / self._n * d,
+                {"d": d, "ys": ys, "idx": state["idx"] + 1})

@@ -1,0 +1,136 @@
+"""Signal processing (reference: python/paddle/signal.py — stft :123,
+istft :327; kernels frame/overlap_add in paddle/phi/kernels/).
+
+TPU formulation: framing is a strided gather, the transform is XLA's FftOp,
+and istft's overlap-add is a scatter-add — all differentiable run_ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor, run_op, to_tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """reference signal.py frame — [..., T] -> [..., frame_length, n_frames]
+    (frame dim before frames, matching the reference layout)."""
+    t = _t(x)
+    if axis not in (-1, t.ndim - 1):
+        raise NotImplementedError("frame: last-axis only")
+
+    def fn(v):
+        n = (v.shape[-1] - frame_length) // hop_length + 1
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])  # [n, frame_length]
+        return jnp.swapaxes(v[..., idx], -1, -2)
+
+    return run_op("frame", fn, [t])
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """reference signal.py overlap_add — [..., frame_length, n_frames] ->
+    [..., T]."""
+    t = _t(x)
+    if axis not in (-1, t.ndim - 1):
+        raise NotImplementedError("overlap_add: last-axis only")
+
+    def fn(v):
+        fl, n = v.shape[-2], v.shape[-1]
+        T = (n - 1) * hop_length + fl
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(fl)[None, :])           # [n, fl]
+        frames = jnp.swapaxes(v, -1, -2)            # [..., n, fl]
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (T,), v.dtype)
+        return out.at[..., idx].add(frames)
+
+    return run_op("overlap_add", fn, [t])
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference signal.py:123 — returns [..., n_fft//2+1 | n_fft, frames]
+    complex."""
+    t = _t(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    has_win = window is not None
+    ins = [t] + ([_t(window)] if has_win else [])
+
+    def fn(v, *rest):
+        w = rest[0] if has_win else jnp.ones(win_length, v.dtype)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode=pad_mode)
+        n = (v.shape[-1] - n_fft) // hop_length + 1
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = v[..., idx] * w                      # [..., n, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)             # [..., freq, frames]
+
+    return run_op("stft", fn, ins)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference signal.py:327 — inverse with window-square overlap-add
+    normalization."""
+    t = _t(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    has_win = window is not None
+    ins = [t] + ([_t(window)] if has_win else [])
+
+    if return_complex and onesided:
+        raise ValueError("return_complex=True requires onesided=False")
+
+    def fn(v, *rest):
+        w = rest[0] if has_win else jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(v, -1, -2)               # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w                           # [..., n, n_fft]
+        n = frames.shape[-2]
+        T = (n - 1) * hop_length + n_fft
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (T,), frames.dtype).at[..., idx].add(frames)
+        # window-square normalization (COLA)
+        wsq = jnp.zeros(T, frames.dtype).at[idx.reshape(-1)].add(
+            jnp.tile(w * w, n))
+        out = out / jnp.maximum(wsq, 1e-10)
+        if center:
+            out = out[..., n_fft // 2:T - n_fft // 2]
+        if length is not None:
+            if out.shape[-1] < length:  # trailing partial frame was dropped
+                out = jnp.pad(out, [(0, 0)] * (out.ndim - 1)
+                              + [(0, length - out.shape[-1])])
+            out = out[..., :length]
+        return out
+
+    return run_op("istft", fn, ins)

@@ -265,3 +265,40 @@ class TestStatic:
             np.testing.assert_allclose(out, 1.0)
         finally:
             paddle.disable_static()
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        """reference signal.py stft/istft: hann-window roundtrip recovers
+        the waveform (COLA)."""
+        from paddle_tpu.audio.functional import get_window
+
+        sr = 4096  # hop-divisible so no trailing partial frame drops
+        t = np.arange(sr) / sr
+        sig = np.sin(2 * np.pi * 440 * t).astype(np.float32)
+        n_fft, hop = 256, 64
+        w = get_window("hann", n_fft)
+        spec = paddle.signal.stft(paddle.to_tensor(sig), n_fft,
+                                  hop_length=hop, window=w)
+        assert spec.shape[0] == n_fft // 2 + 1
+        back = paddle.signal.istft(spec, n_fft, hop_length=hop, window=w,
+                                   length=len(sig))
+        np.testing.assert_allclose(back.numpy(), sig, atol=1e-4)
+
+    def test_stft_numpy_parity(self):
+        rng = np.random.default_rng(0)
+        sig = rng.standard_normal(512).astype(np.float32)
+        n_fft, hop = 128, 32
+        spec = paddle.signal.stft(paddle.to_tensor(sig), n_fft,
+                                  hop_length=hop, center=False).numpy()
+        n = (len(sig) - n_fft) // hop + 1
+        frames = np.stack([sig[i * hop:i * hop + n_fft] for i in range(n)])
+        ref = np.fft.rfft(frames, axis=-1).T
+        np.testing.assert_allclose(spec, ref, rtol=1e-4, atol=1e-4)
+
+    def test_frame_overlap_add_inverse(self):
+        x = paddle.to_tensor(np.arange(32, dtype=np.float32))
+        fr = paddle.signal.frame(x, 8, 8)  # non-overlapping
+        assert tuple(fr.shape) == (8, 4)
+        back = paddle.signal.overlap_add(fr, 8)
+        np.testing.assert_allclose(back.numpy(), x.numpy())

@@ -330,6 +330,10 @@ class TestOptimizers:
         ("AdamW", {"learning_rate": 0.05, "weight_decay": 0.01}),
         ("RMSProp", {"learning_rate": 0.01}),
         ("Lamb", {"learning_rate": 0.1}),
+        ("NAdam", {"learning_rate": 0.05}),
+        ("RAdam", {"learning_rate": 0.05}),
+        ("Rprop", {"learning_rate": 0.001}),
+        ("ASGD", {"learning_rate": 0.05, "batch_num": 2}),
     ])
     def test_optimizers_reduce_loss(self, cls, kw):
         first, last = self._train(getattr(paddle.optimizer, cls), **kw)
@@ -381,6 +385,31 @@ class TestOptimizers:
             warm.step()
         assert vals[0] == 0.0 and abs(vals[4] - 0.08) < 1e-6
         assert vals[6] < 0.1  # cosine decay began
+
+    def test_lbfgs_solves_quadratic(self):
+        """LBFGS (closure-based, strong-Wolfe) drives a linear least-squares
+        problem to ~0 in a few outer steps (reference optimizer/lbfgs.py)."""
+        paddle.seed(0)
+        rng = np.random.default_rng(0)
+        X = paddle.to_tensor(rng.normal(size=(32, 4)).astype(np.float32))
+        W = rng.normal(size=(4, 1)).astype(np.float32)
+        Y = paddle.to_tensor((X.numpy() @ W).astype(np.float32))
+        m = paddle.nn.Linear(4, 1)
+        mse = paddle.nn.MSELoss()
+        o = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=10,
+                                   line_search_fn="strong_wolfe",
+                                   parameters=m.parameters())
+
+        def closure():
+            o.clear_grad()
+            loss = mse(m(X), Y)
+            loss.backward()
+            return loss
+
+        l0 = float(closure().numpy())
+        for _ in range(3):
+            loss = o.step(closure)
+        assert float(loss.numpy()) < l0 * 1e-3
 
     def test_optimizer_state_dict(self):
         net = nn.Linear(2, 2)
