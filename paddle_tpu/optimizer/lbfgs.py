@@ -50,7 +50,21 @@ class LBFGS(Optimizer):
         self.line_search_fn = line_search_fn
         self._s: list = []   # param deltas
         self._y: list = []   # grad deltas
-        self._prev_flat_grad = None
+
+    # curvature history must survive checkpointing, or a resumed LBFGS
+    # silently degrades to steepest descent
+    def state_dict(self):
+        out = super().state_dict()
+        out["lbfgs_s"] = [Tensor(s) for s in self._s]
+        out["lbfgs_y"] = [Tensor(y) for y in self._y]
+        return out
+
+    def set_state_dict(self, state):
+        self._s = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                   for t in state.pop("lbfgs_s", [])]
+        self._y = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                   for t in state.pop("lbfgs_y", [])]
+        super().set_state_dict(state)
 
     # ------------------------------------------------------------------ #
 
@@ -104,13 +118,24 @@ class LBFGS(Optimizer):
             q = q + (a - b) * s
         return q
 
+    def _decay_term(self, params):
+        wd = self._decay_coeff()
+        if not wd:
+            return 0.0
+        # the line search must test f and g of the SAME objective: the L2
+        # term folded into _gather_flat_grad needs its 0.5*wd*||p||^2 value
+        # counterpart here
+        return 0.5 * wd * float(sum(
+            jnp.sum(jnp.square(p._value.astype(jnp.float32)))
+            for p in params))
+
     @no_grad()
     def step(self, closure):
         """One L-BFGS outer step; `closure` re-evaluates loss + grads."""
         params = self._params()
         with _grad_enabled():
             loss = closure()
-        loss_val = float(loss.numpy())
+        loss_val = float(loss.numpy()) + self._decay_term(params)
         flat_grad = self._gather_flat_grad(params)
         n_evals = 1
         lr = self.get_lr()
@@ -134,7 +159,8 @@ class LBFGS(Optimizer):
                 self._set_flat_params(params, x0 + step_size * d)
                 with _grad_enabled():
                     ls = closure()
-                return float(ls.numpy()), self._gather_flat_grad(params)
+                return (float(ls.numpy()) + self._decay_term(params),
+                        self._gather_flat_grad(params))
 
             if self.line_search_fn == "strong_wolfe":
                 t, new_loss, new_grad, evals = _strong_wolfe(

@@ -23,6 +23,10 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
     t = _t(x)
     if axis not in (-1, t.ndim - 1):
         raise NotImplementedError("frame: last-axis only")
+    if t.shape[-1] < frame_length:
+        raise ValueError(
+            f"frame: input length {t.shape[-1]} < frame_length "
+            f"{frame_length}")
 
     def fn(v):
         n = (v.shape[-1] - frame_length) // hop_length + 1
@@ -61,6 +65,11 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     t = _t(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    effective = t.shape[-1] + (n_fft if center else 0)
+    if effective < n_fft:
+        raise ValueError(
+            f"stft: input length {t.shape[-1]} too short for n_fft {n_fft} "
+            f"(center={center}) — would produce zero frames")
     has_win = window is not None
     ins = [t] + ([_t(window)] if has_win else [])
 

@@ -312,6 +312,32 @@ class TestGPTPipe:
         np.testing.assert_allclose(l_1f1b, l_gpipe, rtol=2e-3, atol=2e-4)
         assert l_1f1b[-1] < l_1f1b[0]
 
+    def test_1f1b_full_hybrid_mesh(self):
+        """1F1B under pp x sharding x mp with sequence parallel — the combo
+        that exposed the cond-wrapped-collective rendezvous deadlock (auto-
+        axis collectives inside pp-divergent control flow). Must train."""
+        from paddle_tpu.models import (
+            GPTForCausalLMPipe, GPTPretrainingCriterion, gpt3_tiny)
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        cfg = gpt3_tiny(sequence_parallel=True)
+        cfg.num_layers = 4
+        mesh = dist.build_mesh(pp=2, sharding=2, mp=2)
+        pipe = GPTForCausalLMPipe(cfg, num_microbatches=2, pp_schedule="1f1b")
+        crit = GPTPretrainingCriterion(cfg)
+        pipe.train()
+        step = dist.DistributedTrainStep(
+            pipe, lambda lg, lb: crit(lg, lb),
+            opt.AdamW(learning_rate=1e-4, parameters=pipe.parameters()),
+            mesh=mesh, sharding_stage=1)
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (4, 16)))
+        labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (4, 16)))
+        losses = [float(step(ids, labels)) for _ in range(3)]
+        dist.env.set_global_mesh(None)
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
     def test_hybrid_train_step_dp_pp_mp(self):
         from paddle_tpu.models import GPTPretrainingCriterion
         import paddle_tpu.optimizer as opt
