@@ -2,5 +2,4 @@ from ..recompute import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
 
 
-def mix_precision_utils():
-    raise NotImplementedError
+from . import mix_precision_utils  # noqa: F401

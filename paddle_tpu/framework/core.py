@@ -575,7 +575,11 @@ class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase);
     stop_gradient defaults to False and it carries a trainable flag."""
 
-    __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer", "need_clip")
+    __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer",
+                 "need_clip",
+                 # f32 grad accumulator for the eager mixed-precision path
+                 # (fleet/utils/mix_precision_utils.py MixPrecisionLayer)
+                 "main_grad", "_register_grad_hook_handle")
 
     def __init__(self, value, trainable: bool = True, name: str | None = None):
         super().__init__(value, stop_gradient=not trainable, name=name)

@@ -60,11 +60,15 @@ class LBFGS(Optimizer):
         return out
 
     def set_state_dict(self, state):
+        # non-destructive: popping would silently strip the curvature
+        # history out of the caller's checkpoint dict
         self._s = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
-                   for t in state.pop("lbfgs_s", [])]
+                   for t in state.get("lbfgs_s", [])]
         self._y = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
-                   for t in state.pop("lbfgs_y", [])]
-        super().set_state_dict(state)
+                   for t in state.get("lbfgs_y", [])]
+        super().set_state_dict(
+            {k: v for k, v in state.items()
+             if k not in ("lbfgs_s", "lbfgs_y")})
 
     # ------------------------------------------------------------------ #
 

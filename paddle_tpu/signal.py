@@ -65,11 +65,15 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     t = _t(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    effective = t.shape[-1] + (n_fft if center else 0)
-    if effective < n_fft:
+    T_in = t.shape[-1]
+    if not center and T_in < n_fft:
         raise ValueError(
-            f"stft: input length {t.shape[-1]} too short for n_fft {n_fft} "
-            f"(center={center}) — would produce zero frames")
+            f"stft: input length {T_in} < n_fft {n_fft} with center=False — "
+            f"would produce zero frames")
+    if center and pad_mode in ("reflect", "symmetric") and T_in <= n_fft // 2:
+        raise ValueError(
+            f"stft: input length {T_in} too short to {pad_mode}-pad by "
+            f"n_fft//2 = {n_fft // 2} (center=True)")
     has_win = window is not None
     ins = [t] + ([_t(window)] if has_win else [])
 

@@ -353,3 +353,40 @@ class TestCollectiveRegressions:
         ranks = hcg.get_dp_sep_parallel_group().ranks
         assert len(ranks) == 4
         assert 0 in ranks
+
+
+class TestMixPrecisionUtils:
+    def test_main_grad_accumulation_and_step(self):
+        """fleet.utils.mix_precision_utils: bf16 grads accumulate into f32
+        main_grad via hooks; the wrapped optimizer steps on them (reference
+        mix_precision_utils.py MixPrecisionLayer :35 / MixPrecisionOptimizer
+        :97)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+            MixPrecisionLayer,
+            MixPrecisionOptimizer,
+        )
+
+        paddle.seed(0)
+        inner = nn.Linear(8, 4)
+        for _, p in inner.named_parameters():
+            p._value = p._value.astype("bfloat16")
+        model = MixPrecisionLayer(inner, dtype="bfloat16")
+        o = MixPrecisionOptimizer(
+            opt.SGD(learning_rate=0.1, parameters=inner.parameters()))
+        losses = []
+        for _ in range(5):
+            x = paddle.to_tensor(
+                np.ones((4, 8), np.float32)).astype("bfloat16")
+            loss = (model(x).astype("float32") ** 2).mean()
+            loss.backward()
+            assert str(inner.weight.main_grad._value.dtype) == "float32"
+            o.step()
+            o.clear_grad()
+            assert inner.weight.main_grad is None  # cleared with grads
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]

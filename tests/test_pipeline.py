@@ -338,6 +338,26 @@ class TestGPTPipe:
         dist.env.set_global_mesh(None)
         assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
 
+    def test_vpp_hybrid_mesh_forward(self):
+        """VPP with mp sharing the mesh (the cond-removal covers this
+        schedule too): forward parity against the single-device scan."""
+        from paddle_tpu.models import gpt3_tiny, GPTForCausalLMPipe
+
+        paddle.seed(0)
+        cfg = gpt3_tiny(sequence_parallel=False)
+        cfg.num_layers = 4
+        pipe = GPTForCausalLMPipe(cfg, num_microbatches=4,
+                                  pp_schedule="vpp", vpp_degree=2)
+        pipe.eval()
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)))
+        dist.env.build_mesh(dp=1, devices=jax.devices()[:1])
+        ref = pipe(ids).numpy()
+        dist.env.build_mesh(pp=2, mp=2)
+        out = pipe(ids).numpy()
+        dist.env.set_global_mesh(None)
+        np.testing.assert_allclose(ref, out, atol=1e-4)
+
     def test_hybrid_train_step_dp_pp_mp(self):
         from paddle_tpu.models import GPTPretrainingCriterion
         import paddle_tpu.optimizer as opt
