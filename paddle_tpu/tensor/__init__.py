@@ -1,6 +1,6 @@
 """Functional tensor op surface (reference: python/paddle/tensor/)."""
 
-from . import creation, linalg, logic, manipulation, math, random, search, stat
+from . import creation, extras, linalg, logic, manipulation, math, random, search, stat
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
@@ -9,6 +9,7 @@ from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 __all__ = (
     list(creation.__all__)
@@ -19,4 +20,11 @@ __all__ = (
     + list(search.__all__)
     + list(stat.__all__)
     + list(random.__all__)
+    + list(extras.__all__)
 )
+
+# generated `<op>_` in-place variants over the assembled namespace
+from .extras import _register_inplace as _reg_inplace  # noqa: E402
+
+__all__ += _reg_inplace(globals())
+del _reg_inplace

@@ -1,0 +1,580 @@
+"""Tensor-op tail (reference: python/paddle/tensor/ math.py/manipulation.py/
+linalg.py exports not covered by the core modules) plus the generated
+in-place variants (reference: the `<op>_` inplace APIs, whose tape semantics
+ride Tensor._inplace_update's snapshot mechanism)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+from ..framework.dtype import convert_dtype
+
+__all__ = [
+    "add_n", "as_complex", "as_real", "block_diag", "broadcast_shape",
+    "cast", "cdist", "cholesky_inverse", "combinations",
+    "cumulative_trapezoid", "trapezoid", "diag_embed", "diagonal",
+    "diagonal_scatter", "dsplit", "hsplit", "vsplit", "tensor_split",
+    "frexp", "gammaln", "gammainc", "gammaincc", "histogram_bin_edges",
+    "i0e", "i1e", "index_fill", "isin", "isneginf", "isposinf", "isreal",
+    "is_complex", "is_floating_point", "is_integer", "logcumsumexp",
+    "lu_unpack", "masked_scatter", "matrix_transpose", "multi_dot",
+    "multigammaln", "negative", "positive", "polar", "polygamma", "rank",
+    "renorm", "reverse", "scatter_nd", "select_scatter", "slice_scatter",
+    "sgn", "shape", "shard_index", "signbit", "sinc", "take",
+    "top_p_sampling", "unflatten", "unstack", "vander",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _u(fn, name, *xs):
+    return run_op(name, fn, [_t(x) for x in xs])
+
+
+# --------------------------------------------------------------------------- #
+# math / special
+# --------------------------------------------------------------------------- #
+
+
+def add_n(inputs, name=None):
+    """reference math.py add_n — elementwise sum of a tensor list."""
+    ts = [_t(x) for x in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    return run_op("add_n", lambda *vs: sum(vs[1:], vs[0]), ts)
+
+
+def negative(x, name=None):
+    return _u(lambda v: -v, "negative", x)
+
+
+def positive(x, name=None):
+    return _u(lambda v: +v, "positive", x)
+
+
+def gammaln(x, name=None):
+    return _u(lambda v: jax.scipy.special.gammaln(v), "gammaln", x)
+
+
+def gammainc(x, y, name=None):
+    return _u(lambda a, b: jax.scipy.special.gammainc(a, b), "gammainc", x, y)
+
+
+def gammaincc(x, y, name=None):
+    return _u(lambda a, b: jax.scipy.special.gammaincc(a, b), "gammaincc", x, y)
+
+
+def multigammaln(x, p, name=None):
+    return _u(lambda v: jax.scipy.special.multigammaln(v, int(p)),
+              "multigammaln", x)
+
+
+def polygamma(x, n, name=None):
+    return _u(lambda v: jax.scipy.special.polygamma(int(n), v), "polygamma", x)
+
+
+def i0e(x, name=None):
+    return _u(lambda v: jax.scipy.special.i0e(v), "i0e", x)
+
+
+def i1e(x, name=None):
+    return _u(lambda v: jax.scipy.special.i1e(v), "i1e", x)
+
+
+def sinc(x, name=None):
+    return _u(lambda v: jnp.sinc(v), "sinc", x)
+
+
+def signbit(x, name=None):
+    return _u(lambda v: jnp.signbit(v), "signbit", x)
+
+
+def sgn(x, name=None):
+    """Complex-aware sign (reference math.py sgn)."""
+    def fn(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+
+    return _u(fn, "sgn", x)
+
+
+def frexp(x, name=None):
+    def fn(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+
+    return run_op("frexp", fn, [_t(x)], n_outputs=2)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        a = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        mx = jnp.max(a)
+        return mx + jnp.log(jnp.cumsum(jnp.exp(a - mx), axis=ax))
+
+    return _u(fn, "logcumsumexp", x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference math.py trapezoid."""
+    ins = [_t(y)] + ([_t(x)] if x is not None else [])
+
+    def fn(yv, *rest):
+        if rest:
+            return jnp.trapezoid(yv, rest[0], axis=axis)
+        return jnp.trapezoid(yv, dx=1.0 if dx is None else dx, axis=axis)
+
+    return run_op("trapezoid", fn, ins)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    ins = [_t(y)] + ([_t(x)] if x is not None else [])
+
+    def fn(yv, *rest):
+        ys = jnp.moveaxis(yv, axis, -1)
+        avg = (ys[..., 1:] + ys[..., :-1]) / 2.0
+        if rest:
+            xs = jnp.moveaxis(rest[0], axis, -1) if rest[0].ndim == yv.ndim else rest[0]
+            d = jnp.diff(xs, axis=-1)
+        else:
+            d = 1.0 if dx is None else dx
+        return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+    return run_op("cumulative_trapezoid", fn, ins)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """reference math.py renorm — clamp sub-tensor p-norms along `axis`."""
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return jnp.moveaxis(moved * scale.reshape(-1, *([1] * (moved.ndim - 1))), 0, axis)
+
+    return _u(fn, "renorm", x)
+
+
+# --------------------------------------------------------------------------- #
+# predicates / casting
+# --------------------------------------------------------------------------- #
+
+
+def cast(x, dtype):
+    """reference manipulation.py cast."""
+    return _t(x).astype(dtype)
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(_t(x)._value.dtype, jnp.complexfloating))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(_t(x)._value.dtype, jnp.floating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(_t(x)._value.dtype, jnp.integer))
+
+
+def isneginf(x, name=None):
+    return _u(lambda v: jnp.isneginf(v), "isneginf", x)
+
+
+def isposinf(x, name=None):
+    return _u(lambda v: jnp.isposinf(v), "isposinf", x)
+
+
+def isreal(x, name=None):
+    return _u(lambda v: jnp.isreal(v), "isreal", x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return _u(lambda a, b: jnp.isin(a, b, invert=invert), "isin", x, test_x)
+
+
+# --------------------------------------------------------------------------- #
+# complex
+# --------------------------------------------------------------------------- #
+
+
+def as_complex(x, name=None):
+    """[..., 2] real pairs -> complex (reference manipulation.py)."""
+    return _u(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), "as_complex", x)
+
+
+def as_real(x, name=None):
+    return _u(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+              "as_real", x)
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    return _u(lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+              "polar", abs, angle)
+
+
+# --------------------------------------------------------------------------- #
+# shapes / manipulation
+# --------------------------------------------------------------------------- #
+
+
+def shape(x):
+    """reference: paddle.shape returns an int tensor."""
+    return Tensor(jnp.asarray(_t(x)._value.shape, jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(_t(x)._value.ndim, jnp.int32))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def matrix_transpose(x, name=None):
+    return _u(lambda v: jnp.swapaxes(v, -1, -2), "matrix_transpose", x)
+
+
+def reverse(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return _u(lambda v: jnp.flip(v, axis=tuple(ax)), "reverse", x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    t = _t(x)
+    n = num or t.shape[axis]
+    return [_u(lambda v, i=i: jnp.take(v, i, axis=axis), "unstack", t)
+            for i in range(n)]
+
+
+def unflatten(x, axis, shape, name=None):  # noqa: A002
+    def fn(v):
+        new = list(v.shape[:axis]) + list(shape) + list(v.shape[axis + 1:])
+        return v.reshape(new)
+
+    return _u(fn, "unflatten", x)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    t = _t(x)
+    if isinstance(num_or_indices, int):
+        pieces = np.array_split(np.arange(t.shape[axis]), num_or_indices)
+        bounds = [int(p[0]) for p in pieces[1:]]
+    else:
+        bounds = list(num_or_indices)
+
+    # through run_op so the splits stay on the autograd tape
+    def fn(v):
+        return tuple(jnp.split(v, bounds, axis=axis))
+
+    out = run_op("tensor_split", fn, [t], n_outputs=len(bounds) + 1)
+    return list(out)
+
+
+def hsplit(x, num_or_indices, name=None):
+    t = _t(x)
+    return tensor_split(t, num_or_indices, axis=1 if t.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def take(x, index, mode="raise", name=None):
+    """reference math.py take — flat-index gather."""
+    def fn(v, i):
+        return jnp.take(v.reshape(-1), i.astype(jnp.int32),
+                        mode="clip" if mode == "clip" else "wrap")
+
+    return _u(fn, "take", x, index)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, i):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[i.astype(jnp.int32)].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return _u(fn, "index_fill", x, index)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """reference manipulation.py masked_scatter — fill True slots with
+    consecutive `value` entries."""
+    def fn(v, m, val):
+        flat_m = m.reshape(-1)
+        idx = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = val.reshape(-1)[jnp.clip(idx, 0, val.size - 1)]
+        return jnp.where(flat_m, src, v.reshape(-1)).reshape(v.shape)
+
+    return _u(fn, "masked_scatter", x, mask, value)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(i, u):
+        out = jnp.zeros(tuple(shape), u.dtype)
+        return out.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+
+    return _u(fn, "scatter_nd", index, updates)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        rng = jnp.arange(v.shape[-1])
+        r = rng + max(-offset, 0)
+        c = rng + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        # paddle's dim1/dim2 choose where the ROW and COLUMN dims land —
+        # order matters (swapping them transposes an off-diagonal embed)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        if d1 == d2:
+            raise ValueError("diag_embed: dim1 and dim2 must differ")
+        pi = iter(i for i in range(nd) if i not in (nd - 2, nd - 1))
+        order = [nd - 2 if i == d1 else nd - 1 if i == d2 else next(pi)
+                 for i in range(nd)]
+        return jnp.transpose(out, order)
+
+    return _u(fn, "diag_embed", x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _u(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                     axis2=axis2), "diagonal", x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(v, u):
+        moved = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        diag_len = min(moved.shape[-2] - max(-offset, 0),
+                       moved.shape[-1] - max(offset, 0))
+        if u.shape[-1] != diag_len:
+            raise ValueError(
+                f"diagonal_scatter: values length {u.shape[-1]} != diagonal "
+                f"length {diag_len} (offset={offset})")
+        rng = jnp.arange(u.shape[-1])
+        r = rng + max(-offset, 0)
+        c = rng + max(offset, 0)
+        moved = moved.at[..., r, c].set(u)
+        return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+
+    return _u(fn, "diagonal_scatter", x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(v, u):
+        return jax.lax.dynamic_update_index_in_dim(
+            v, u.astype(v.dtype), index, axis)
+
+    return _u(fn, "select_scatter", x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(v, u):
+        sl = [slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = slice(st, en, sd)
+        return v.at[tuple(sl)].set(u.astype(v.dtype))
+
+    return _u(fn, "slice_scatter", x, value)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    """reference manipulation.py shard_index (PS-era embedding sharding)."""
+    size = (index_num + nshards - 1) // nshards  # ceil, per the reference
+
+    def fn(v):
+        shard = v // size
+        local = v % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return _u(fn, "shard_index", x)
+
+
+# --------------------------------------------------------------------------- #
+# linalg tail
+# --------------------------------------------------------------------------- #
+
+
+def multi_dot(x, name=None):
+    ts = [_t(a) for a in x]
+    return run_op("multi_dot",
+                  lambda *vs: jnp.linalg.multi_dot(list(vs)), ts)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def fn(L):
+        A = (L.T @ L) if upper else (L @ L.T)
+        return jnp.linalg.inv(A)
+
+    return _u(fn, "cholesky_inverse", x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, axis=-1), 0.0))
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return _u(fn, "cdist", x, y)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """reference linalg.py lu_unpack: (LU, pivots) -> (P, L, U)."""
+    if _t(x).ndim > 2:
+        raise NotImplementedError("lu_unpack: batched factorizations are "
+                                  "not supported yet")
+
+    def fn(lu, piv):
+        m = lu.shape[-2]
+        n = lu.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[..., :k, :])
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[..., i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jnp.eye(m, dtype=lu.dtype)[perm].T
+        return P, L, U
+
+    return run_op("lu_unpack", fn, [_t(x), _t(y)], n_outputs=3)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def fn(v):
+        return jnp.vander(v, N=n, increasing=increasing)
+
+    return _u(fn, "vander", x)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    t = _t(x)
+    m = t.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.asarray(list(gen(range(m), r)), np.int32).reshape(-1, r)
+
+    def fn(v):
+        return v[jnp.asarray(idx)]
+
+    return _u(fn, "combinations", t)
+
+
+def block_diag(inputs, name=None):
+    ts = [_t(a) for a in inputs]
+    return run_op("block_diag",
+                  lambda *vs: jax.scipy.linalg.block_diag(*vs), ts)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    t = _t(input)
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        v = np.asarray(t._value)
+        lo, hi = float(v.min()), float(v.max())
+    return Tensor(jnp.linspace(lo, hi, int(bins) + 1))
+
+
+# --------------------------------------------------------------------------- #
+# sampling
+# --------------------------------------------------------------------------- #
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over logits [B, V] (reference math.py
+    top_p_sampling, kernel fusion/gpu/top_p_sampling). Returns
+    (values, ids)."""
+    from ..framework import random as rnd
+
+    key = rnd.next_key() if seed is None else jax.random.PRNGKey(int(seed))
+
+    def fn(logits, p):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = cum - sorted_p <= p.reshape(-1, 1)
+        filtered = jnp.where(keep, sorted_p, 0.0)
+        filtered = filtered / jnp.sum(filtered, axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(filtered + 1e-20), axis=-1)
+        ids = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)
+        vals = jnp.take_along_axis(probs, ids, axis=-1)
+        return vals, ids.astype(jnp.int32)
+
+    return run_op("top_p_sampling", fn, [_t(x), _t(ps)], n_outputs=2)
+
+
+# --------------------------------------------------------------------------- #
+# generated in-place variants (reference: the `<op>_` API family)
+# --------------------------------------------------------------------------- #
+
+_INPLACE_BASES = [
+    "abs", "acos", "acosh", "add", "asin", "asinh", "atan", "atanh", "ceil",
+    "clip", "cos", "cosh", "cumprod", "cumsum", "divide", "equal", "erfinv",
+    "exp", "floor", "floor_divide", "frac", "gcd", "greater_equal",
+    "greater_than", "lcm", "lerp", "less_equal", "less_than", "lgamma",
+    "log", "log10", "log1p", "log2", "logical_and", "logical_not",
+    "logical_or", "logical_xor", "logit", "mod", "multiply", "nan_to_num",
+    "neg", "not_equal", "pow", "reciprocal", "remainder", "reshape",
+    "round", "rsqrt", "scale", "scatter", "sigmoid", "sin", "sinh", "sqrt",
+    "square", "squeeze", "subtract", "t", "tan", "tanh", "tril", "triu",
+    "trunc", "unsqueeze", "where",
+]
+
+
+def _make_inplace(base_name, base_fn):
+    def inplace(x, *args, **kwargs):
+        t = x if isinstance(x, Tensor) else to_tensor(x)
+        out = base_fn(t, *args, **kwargs)
+        t._inplace_update(out)
+        return t
+
+    inplace.__name__ = base_name + "_"
+    inplace.__doc__ = (f"In-place variant of `{base_name}` (reference "
+                       f"{base_name}_); tape semantics via "
+                       f"Tensor._inplace_update snapshots.")
+    return inplace
+
+
+def _register_inplace(namespace: dict):
+    """Create `<op>_` for every base present in `namespace`; returns the
+    new names (called from tensor/__init__)."""
+    created = []
+    for base in _INPLACE_BASES:
+        fn = namespace.get(base)
+        if fn is None or (base + "_") in namespace:
+            continue
+        inplace = _make_inplace(base, fn)
+        namespace[base + "_"] = inplace
+        if not hasattr(Tensor, base + "_"):
+            register_tensor_method(base + "_", inplace)
+        created.append(base + "_")
+    return created
+
+
+# register as Tensor methods (paddle-style), skipping anything that would
+# shadow an existing Tensor attribute/property (shape, rank, cast-alias...)
+_SKIP_METHODS = {n for n in __all__ if hasattr(Tensor, n)}
+for _name in list(__all__):
+    if _name not in _SKIP_METHODS:
+        register_tensor_method(_name, globals()[_name])
