@@ -104,7 +104,7 @@ from . import metric  # noqa: E402
 from . import vision  # noqa: E402
 from . import jit  # noqa: E402
 from . import hapi  # noqa: E402
-from .hapi import Model, summary  # noqa: E402
+from .hapi import Model, flops, summary  # noqa: E402
 from . import distributed  # noqa: E402
 from .distributed import DataParallel  # noqa: E402
 from . import incubate  # noqa: E402

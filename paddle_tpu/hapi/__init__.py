@@ -1,5 +1,6 @@
 from . import callbacks
 from .callbacks import Callback, EarlyStopping, ModelCheckpoint, ProgBarLogger
+from .dynamic_flops import flops
 from .model import Model, summary
 
-__all__ = ["Model", "summary", "callbacks", "Callback", "EarlyStopping", "ModelCheckpoint", "ProgBarLogger"]
+__all__ = ["Model", "summary", "flops", "callbacks", "Callback", "EarlyStopping", "ModelCheckpoint", "ProgBarLogger"]

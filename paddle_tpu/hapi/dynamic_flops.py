@@ -1,0 +1,138 @@
+"""Dynamic FLOP counter (reference: python/paddle/hapi/dynamic_flops.py —
+paddle.flops(net, input_size) walking leaf layers with per-type counting
+hooks)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn as nn
+
+__all__ = ["flops"]
+
+
+def _count_linear(layer, x, y):
+    in_f = int(np.prod(x.shape)) // x.shape[-1] if x.ndim else 1
+    return 2 * in_f * layer.weight.shape[0] * layer.weight.shape[1]
+
+
+def _count_conv(layer, x, y):
+    # 2 * out_elems * (Cin/groups * kh * kw)
+    out_elems = int(np.prod(y.shape))
+    w = layer.weight
+    per_out = 2 * int(np.prod(w.shape[1:]))
+    return out_elems * per_out
+
+
+def _count_norm(layer, x, y):
+    return 2 * int(np.prod(x.shape))
+
+
+def _count_act(layer, x, y):
+    return int(np.prod(y.shape))
+
+
+def _count_pool(layer, x, y):
+    return int(np.prod(y.shape))
+
+
+def _count_embedding(layer, x, y):
+    return 0
+
+
+_COUNTERS = []
+
+
+def _build_counters():
+    if _COUNTERS:
+        return _COUNTERS
+    table = [
+        ((nn.Linear,), _count_linear),
+        ((nn.Conv1D, nn.Conv2D, nn.Conv3D) if hasattr(nn, "Conv1D")
+         else (nn.Conv2D,), _count_conv),
+        ((nn.LayerNorm, nn.BatchNorm2D, nn.BatchNorm, nn.GroupNorm),
+         _count_norm),
+        ((nn.ReLU, nn.GELU, nn.Silu, nn.Sigmoid, nn.Tanh, nn.Hardswish,
+          nn.ReLU6), _count_act),
+        ((nn.MaxPool2D, nn.AvgPool2D, nn.AdaptiveAvgPool2D)
+         if hasattr(nn, "MaxPool2D") else (), _count_pool),
+        ((nn.Embedding,), _count_embedding),
+    ]
+    for types, fn in table:
+        if types:
+            _COUNTERS.append((types, fn))
+    return _COUNTERS
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """reference: paddle.flops (hapi/dynamic_flops.py flops). Runs a zeros
+    forward with counting hooks on leaf layers; returns total FLOPs."""
+    import paddle_tpu as paddle
+
+    counters = _build_counters()
+    custom_ops = custom_ops or {}
+    records = []
+    handles = []
+
+    def make_hook(layer, count_fn):
+        def hook(lyr, inputs, output):
+            try:
+                x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+                n = int(count_fn(lyr, x, output))
+            except Exception:
+                n = 0
+            records.append((type(lyr).__name__, n))
+            return output
+
+        return hook
+
+    import warnings
+
+    seen = set()
+    uncovered = set()
+    for _, sub in net.named_sublayers():
+        if sub._sub_layers:  # leaves only (O(1) check)
+            continue
+        if id(sub) in seen:  # shared layer: one hook, no double count
+            continue
+        seen.add(id(sub))
+        count_fn = custom_ops.get(type(sub))
+        if count_fn is None:
+            for types, fn in counters:
+                if isinstance(sub, types):
+                    count_fn = fn
+                    break
+        if count_fn is not None:
+            handles.append(sub.register_forward_post_hook(
+                make_hook(sub, count_fn)))
+        else:
+            uncovered.add(type(sub).__name__)
+    if uncovered:
+        warnings.warn(
+            f"paddle.flops: no count function for layer type(s) "
+            f"{sorted(uncovered)} — totals exclude them")
+
+    # restore per-sublayer modes: a blanket net.train() would un-freeze
+    # sublayers deliberately held in eval
+    modes = [(net, net.training)] + [(s_, s_.training)
+                                     for _, s_ in net.named_sublayers()]
+    net.eval()
+    try:
+        x = paddle.zeros(list(input_size), dtype="float32")
+        net(x)
+    finally:
+        for h in handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+        for lyr, mode in modes:
+            lyr.training = mode
+
+    total = sum(n for _, n in records)
+    if print_detail:
+        for name, n in records:
+            print(f"  {name:<24} {n:,}")
+    print(f"Total Flops: {total:,}     Total Params: "
+          f"{sum(int(np.prod(p.shape)) for _, p in net.named_parameters()):,}")
+    return total

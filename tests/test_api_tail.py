@@ -302,3 +302,20 @@ class TestSignal:
         assert tuple(fr.shape) == (8, 4)
         back = paddle.signal.overlap_add(fr, 8)
         np.testing.assert_allclose(back.numpy(), x.numpy())
+
+
+class TestFlops:
+    def test_flops_counts_linear_chain(self):
+        """paddle.flops (reference hapi/dynamic_flops.py): per-layer hook
+        counting on a zeros forward."""
+        import paddle_tpu.nn as nn
+
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        total = paddle.flops(m, [2, 16])
+        assert total == 2 * 2 * 16 * 32 + 2 * 2 * 32 * 4 + 2 * 32
+
+    def test_flops_conv_model(self):
+        from paddle_tpu.vision.models import LeNet
+
+        total = paddle.flops(LeNet(), [1, 1, 28, 28])
+        assert total > 1e5
