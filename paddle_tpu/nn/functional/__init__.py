@@ -20,3 +20,7 @@ from .flash_attention import *  # noqa: F401,F403,E402
 from .loss import *  # noqa: F401,F403,E402
 from .norm import *  # noqa: F401,F403,E402
 from .pooling import *  # noqa: F401,F403,E402
+
+from .extras import *  # noqa: F401,F403,E402
+from . import extras as _extras  # noqa: E402
+__all__ = list(__all__) + list(_extras.__all__)

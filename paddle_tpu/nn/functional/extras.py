@@ -1,0 +1,374 @@
+"""nn.functional tail (reference: python/paddle/nn/functional/ — vision.py
+grid_sample/affine_grid, loss.py gaussian_nll/poisson_nll/soft_margin/
+multi_label_soft_margin/triplet_margin_with_distance/npair/dice, common.py
+sequence_mask/zeropad2d/pairwise_distance, extension.py gather_tree/
+temporal_shift, flash_attention.py qkvpacked wrappers)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "affine_grid", "grid_sample", "pairwise_distance", "sequence_mask",
+    "zeropad2d", "temporal_shift", "gather_tree", "dice_loss",
+    "gaussian_nll_loss", "poisson_nll_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "triplet_margin_with_distance_loss",
+    "npair_loss", "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+# --------------------------------------------------------------------------- #
+# spatial transformer (reference vision.py affine_grid :33, grid_sample :276)
+# --------------------------------------------------------------------------- #
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2]."""
+    N, C, H, W = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)  # [H, W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base)
+
+    return run_op("affine_grid", fn, [_t(theta)])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [N, C, H, W], grid [N, Hg, Wg, 2] in [-1, 1] -> [N, C, Hg, Wg]."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"grid_sample: unsupported padding {padding_mode!r}")
+
+    def fn(v, g):
+        N, C, H, W = v.shape
+        gx = g[..., 0].astype(jnp.float32)
+        gy = g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def reflect(f, n):
+            if align_corners:
+                span = 2 * (n - 1)
+                f = jnp.abs(jnp.mod(f, span))
+                return jnp.where(f > n - 1, span - f, f)
+            span = 2 * n
+            f = jnp.mod(jnp.abs(f + 0.5), span)
+            f = jnp.where(f > n, span - f, f) - 0.5
+            return jnp.clip(f, 0, n - 1)
+
+        if padding_mode == "reflection":
+            fx = reflect(fx, W)
+            fy = reflect(fy, H)
+
+        def sample(ix, iy):
+            """Gather with out-of-range handling -> [N, Hg, Wg, C]."""
+            inside = ((ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1))
+            cx = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            cy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            vals = jax.vmap(
+                lambda img, yy, xx: img[:, yy, xx])(v, cy, cx)  # [N,C,Hg,Wg]
+            if padding_mode == "zeros":
+                vals = jnp.where(inside[:, None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            return sample(jnp.round(fx), jnp.round(fy))
+
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1 = fx - x0
+        wy1 = fy - y0
+        wx0 = 1 - wx1
+        wy0 = 1 - wy1
+        out = (sample(x0, y0) * (wx0 * wy0)[:, None]
+               + sample(x1, y0) * (wx1 * wy0)[:, None]
+               + sample(x0, y1) * (wx0 * wy1)[:, None]
+               + sample(x1, y1) * (wx1 * wy1)[:, None])
+        return out.astype(v.dtype)
+
+    return run_op("grid_sample", fn, [_t(x), _t(grid)])
+
+
+# --------------------------------------------------------------------------- #
+# common
+# --------------------------------------------------------------------------- #
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return run_op("pairwise_distance", fn, [_t(x), _t(y)])
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths [.., B] -> [..., maxlen] 0/1 mask (reference common.py)."""
+    t = _t(x)
+    import numpy as np
+
+    if maxlen is not None:
+        ml = int(maxlen)
+    else:
+        if isinstance(t._value, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask: maxlen=None needs a concrete lengths tensor "
+                "(it sets the output shape); pass maxlen explicitly under "
+                "jit/to_static")
+        ml = int(np.asarray(t._value).max())
+    from ...framework.dtype import convert_dtype
+
+    nd = convert_dtype(dtype)
+    if str(nd) == "int64" and not jax.config.jax_enable_x64:
+        nd = jnp.int32  # avoid the per-call truncation warning
+
+    def fn(v):
+        rng = jnp.arange(ml)
+        return (rng < v[..., None]).astype(nd)
+
+    return run_op("sequence_mask", fn, [t])
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, top, bot = [int(p) for p in padding]
+
+    def fn(v):
+        if data_format == "NCHW":
+            return jnp.pad(v, ((0, 0), (0, 0), (top, bot), (l, r)))
+        return jnp.pad(v, ((0, 0), (top, bot), (l, r), (0, 0)))
+
+    return run_op("zeropad2d", fn, [_t(x)])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM shift (reference extension.py temporal_shift)."""
+    def fn(v):
+        if data_format != "NCHW":
+            v = jnp.moveaxis(v, -1, 1)
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        v5 = v.reshape(N, seg_num, C, H, W)
+        fold = int(C * shift_ratio)
+        back = jnp.roll(v5[:, :, :fold], -1, axis=1).at[:, -1, :].set(0.0)
+        fwd = jnp.roll(v5[:, :, fold:2 * fold], 1, axis=1).at[:, 0, :].set(0.0)
+        keep = v5[:, :, 2 * fold:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(NT, C, H, W)
+        if data_format != "NCHW":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return run_op("temporal_shift", fn, [_t(x)])
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace [T, B, K] (reference extension.py gather_tree;
+    kernel phi/kernels/gather_tree_kernel)."""
+    def fn(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, xs):
+            beam = carry  # [B, K] beam index at time t+1
+            ids_t, par_t = xs
+            out = jnp.take_along_axis(ids_t, beam, axis=-1)
+            beam_prev = jnp.take_along_axis(par_t, beam, axis=-1)
+            return beam_prev.astype(beam.dtype), out
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[-1], dtype=jnp.int32),
+                                idv.shape[1:])
+        _, outs = jax.lax.scan(step, init, (idv[::-1], par[::-1]))
+        return outs[::-1]
+
+    return run_op("gather_tree", fn, [_t(ids), _t(parents)])
+
+
+# --------------------------------------------------------------------------- #
+# loss tail
+# --------------------------------------------------------------------------- #
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """reference loss.py dice_loss — input [N, ..., C] probs, label
+    [N, ..., 1] class ids."""
+    def fn(p, lab):
+        C = p.shape[-1]
+        one_hot = jax.nn.one_hot(lab[..., 0].astype(jnp.int32), C,
+                                 dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * one_hot, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(one_hot, axis=red)
+        # epsilon in the denominator only (reference loss.py dice_loss)
+        return jnp.mean(1 - 2 * inter / (union + epsilon))
+
+    return run_op("dice_loss", fn, [_t(input), _t(label)])
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,  # noqa: A002
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            import math
+
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+
+    return run_op("gaussian_nll_loss", fn,
+                  [_t(input), _t(label), _t(variance)])
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return run_op("poisson_nll_loss", fn, [_t(input), _t(label)])
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    def fn(x, y):
+        # softplus form: log1p(exp(z)) overflows f32 past z ~ 89
+        return _reduce(jax.nn.softplus(-y.astype(x.dtype) * x), reduction)
+
+    return run_op("soft_margin_loss", fn, [_t(input), _t(label)])
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    ins = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+
+    def fn(x, y, *rest):
+        y = y.astype(x.dtype)
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    return run_op("multi_label_soft_margin_loss", fn, ins)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (
+        lambda a, b: pairwise_distance(a, b))
+
+    def raw(a, b):
+        out = dist(a, b)
+        return out._value if isinstance(out, Tensor) else out
+
+    def fn(a, p, n):
+        d_ap = raw(Tensor(a), Tensor(p))
+        d_an = raw(Tensor(a), Tensor(n))
+        if swap:
+            d_pn = raw(Tensor(p), Tensor(n))
+            d_an = jnp.minimum(d_an, d_pn)
+        return _reduce(jnp.maximum(d_ap - d_an + margin, 0.0), reduction)
+
+    return run_op("triplet_margin_with_distance_loss", fn,
+                  [_t(input), _t(positive), _t(negative)])
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference loss.py npair_loss."""
+    def fn(a, p, y):
+        B = a.shape[0]
+        sim = a @ p.T  # [B, B]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(same * logp, axis=1))
+        # reference uses Beta = 0.25 * l2_reg
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return ce + reg
+
+    return run_op("npair_loss", fn, [_t(anchor), _t(positive), _t(labels)])
+
+
+# --------------------------------------------------------------------------- #
+# qkv-packed flash attention wrappers
+# --------------------------------------------------------------------------- #
+
+
+def _unpack_qkv(t, axis):
+    # ONE dispatch for all three slices (run_op supports tuple outputs)
+    def fn(v):
+        return (jnp.take(v, 0, axis=axis), jnp.take(v, 1, axis=axis),
+                jnp.take(v, 2, axis=axis))
+
+    return run_op("qkv_unpack", fn, [t], n_outputs=3)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         *args, **kwargs):
+    """qkv [B, S, 3, H, D] (reference flash_attention.py
+    flash_attn_qkvpacked) — unpacks and routes to flash_attention."""
+    from .flash_attention import flash_attention
+
+    q, k, v = _unpack_qkv(_t(qkv), axis=2)
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale, dropout=0.0,
+                                causal=False, varlen_padded=True,
+                                return_softmax=False, **kwargs):
+    """qkv [T, 3, H, D] PACKED varlen (reference
+    flash_attn_varlen_qkvpacked). The reference's default varlen_padded=True
+    layout ([B*maxlen, ...] with padding rows) is a different memory
+    convention — silently reading it as packed would misalign every
+    sequence, so it must be disabled explicitly."""
+    if varlen_padded:
+        raise NotImplementedError(
+            "flash_attn_varlen_qkvpacked: the padded [B*maxlen, 3, H, D] "
+            "layout is not supported; pass varlen_padded=False with densely "
+            "packed tokens")
+    from .flash_attention import flash_attn_unpadded
+
+    q, k, v = _unpack_qkv(_t(qkv), axis=1)
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax)

@@ -1,0 +1,141 @@
+"""nn.functional tail (reference: python/paddle/nn/functional/ vision.py
+grid_sample/affine_grid, loss.py tail, common.py sequence_mask/zeropad2d,
+extension.py temporal_shift/gather_tree, qkvpacked flash wrappers)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSpatialTransformer:
+    def test_identity_affine_grid_sample(self):
+        x = _t(np.random.default_rng(0).standard_normal((2, 3, 5, 7))
+               .astype(np.float32))
+        theta = _t(np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                           (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 5, 7])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+
+    def test_shift_and_padding_modes(self):
+        x = _t(np.arange(12, dtype=np.float32).reshape(1, 1, 3, 4))
+        # shift one pixel right in grid space = sample one pixel to the right
+        theta = _t(np.array([[[1.0, 0, 2.0 / 3.0], [0, 1.0, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 1, 3, 4])
+        border = F.grid_sample(x, grid, padding_mode="border").numpy()
+        np.testing.assert_allclose(border[..., :-1], x.numpy()[..., 1:],
+                                   atol=1e-4)
+        zeros = F.grid_sample(x, grid, padding_mode="zeros").numpy()
+        np.testing.assert_allclose(zeros[..., -1], 0.0, atol=1e-4)
+        nearest = F.grid_sample(x, grid, mode="nearest").numpy()
+        assert np.isfinite(nearest).all()
+
+    def test_grid_sample_grad(self):
+        x = _t(np.ones((1, 1, 4, 4), np.float32))
+        x.stop_gradient = False
+        theta = _t(np.array([[[1.0, 0, 0.1], [0, 1.0, -0.1]]], np.float32))
+        grid = F.affine_grid(theta, [1, 1, 4, 4])
+        F.grid_sample(x, grid).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+class TestCommonTail:
+    def test_sequence_mask_and_zeropad(self):
+        sm = F.sequence_mask(_t(np.array([1, 3])), maxlen=4)
+        np.testing.assert_array_equal(sm.numpy(),
+                                      [[1, 0, 0, 0], [1, 1, 1, 0]])
+        zp = F.zeropad2d(_t(np.ones((1, 1, 2, 2), np.float32)), [1, 0, 0, 2])
+        assert tuple(zp.shape) == (1, 1, 4, 3)
+        assert float(zp.numpy().sum()) == 4.0
+
+    def test_pairwise_distance(self):
+        d = F.pairwise_distance(_t(np.zeros((2, 3), np.float32)),
+                                _t(np.ones((2, 3), np.float32)))
+        np.testing.assert_allclose(d.numpy(), np.sqrt(3), rtol=1e-4)
+
+    def test_temporal_shift(self):
+        x = _t(np.random.default_rng(0).standard_normal((4, 8, 2, 2))
+               .astype(np.float32))  # N=2 segments of T=2
+        out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        assert tuple(out.shape) == (4, 8, 2, 2)
+        v = x.numpy().reshape(2, 2, 8, 2, 2)
+        o = out.numpy().reshape(2, 2, 8, 2, 2)
+        # first fold shifted backward: o[:, t, :2] == v[:, t+1, :2]
+        np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])
+        np.testing.assert_allclose(o[:, 1, :2], 0.0)
+        # untouched tail channels identical
+        np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])
+
+    def test_gather_tree(self):
+        # T=2, B=1, K=2; beam 0 at t=1 came from parent 1
+        ids = _t(np.array([[[2, 5]], [[6, 1]]]))
+        parents = _t(np.array([[[0, 0]], [[1, 0]]]))
+        out = F.gather_tree(ids, parents).numpy()
+        # final beam 0: path = ids[0][parent chain] -> t0 from parent 1 (=5)
+        np.testing.assert_array_equal(out[:, 0, 0], [5, 6])
+        np.testing.assert_array_equal(out[:, 0, 1], [2, 1])
+
+
+class TestLossTail:
+    def test_gaussian_and_poisson_nll(self):
+        z = _t(np.zeros(4, np.float32))
+        one = _t(np.ones(4, np.float32))
+        np.testing.assert_allclose(
+            float(F.gaussian_nll_loss(z, z, one).numpy()), 0.0, atol=1e-6)
+        # poisson log-input: exp(x) - y*x at x=0,y=1 -> 1
+        np.testing.assert_allclose(
+            float(F.poisson_nll_loss(z, one).numpy()), 1.0, atol=1e-6)
+
+    def test_margin_losses(self):
+        x = _t(np.array([10.0, -10.0], np.float32))
+        y = _t(np.array([1.0, -1.0], np.float32))
+        assert float(F.soft_margin_loss(x, y).numpy()) < 1e-3
+        ml = F.multi_label_soft_margin_loss(
+            _t(np.array([[10.0, -10.0]], np.float32)),
+            _t(np.array([[1.0, 0.0]], np.float32)))
+        assert float(ml.numpy()) < 1e-3
+        tl = F.triplet_margin_with_distance_loss(
+            _t(np.zeros((2, 3), np.float32)),
+            _t(np.zeros((2, 3), np.float32)),
+            _t(np.full((2, 3), 10.0, np.float32)), margin=1.0)
+        np.testing.assert_allclose(float(tl.numpy()), 0.0, atol=1e-5)
+
+    def test_dice_and_npair(self):
+        probs = _t(np.array([[[0.9, 0.1], [0.2, 0.8]]], np.float32))
+        labels = _t(np.array([[[0], [1]]]))
+        d = F.dice_loss(probs, labels)
+        assert 0 <= float(d.numpy()) < 0.3
+        a = _t(np.eye(4, 8, dtype=np.float32))
+        y = _t(np.arange(4))
+        n = F.npair_loss(a, a, y)
+        assert np.isfinite(float(n.numpy()))
+
+
+class TestQKVPacked:
+    def test_qkvpacked_matches_unpacked(self):
+        rng = np.random.default_rng(0)
+        qkv = rng.standard_normal((2, 16, 3, 2, 8)).astype(np.float32)
+        out, _ = F.flash_attn_qkvpacked(_t(qkv), causal=True)
+        ref, _ = F.flash_attention(_t(qkv[:, :, 0]), _t(qkv[:, :, 1]),
+                                   _t(qkv[:, :, 2]), causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-6)
+
+    def test_varlen_qkvpacked(self):
+        rng = np.random.default_rng(1)
+        qkv = rng.standard_normal((20, 3, 2, 8)).astype(np.float32)
+        cu = _t(np.array([0, 8, 20], np.int32))
+        out, _ = F.flash_attn_varlen_qkvpacked(
+            _t(qkv), cu, cu, 12, 12, 8 ** -0.5, causal=True,
+            varlen_padded=False)
+        assert tuple(out.shape) == (20, 2, 8)
+        assert np.isfinite(out.numpy()).all()
+        # the reference's padded default is a different memory layout:
+        # reading it as packed would silently misalign, so it must raise
+        with pytest.raises(NotImplementedError, match="varlen_padded"):
+            F.flash_attn_varlen_qkvpacked(_t(qkv), cu, cu, 12, 12, 8 ** -0.5)
