@@ -307,6 +307,9 @@ long tcp_store_tryget(intptr_t fd, const char* key, void* buf, long cap) {
   std::string out;
   if (request(static_cast<int>(fd), 6, key, nullptr, 0, &out) != 0) return -1;
   if (out.empty() || out[0] == '\0') return -2;
+  // '\xff' is the server's unknown-op error reply (version skew) — a
+  // protocol error, not a stored value.
+  if (out[0] == '\xff') return -1;
   long n = static_cast<long>(out.size()) - 1;
   memcpy(buf, out.data() + 1, std::min<long>(n, cap));
   return n;

@@ -148,6 +148,12 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # Per-optimizer state machine (reference grad_scaler.py:354-373):
+        # INIT -> UNSCALED (explicit unscale_) -> STEPPED (step) -> INIT
+        # (update). step() skips unscaling when the user already called
+        # unscale_(opt); unscale_ after unscale_ or step raises; the
+        # finite-check result is tracked per optimizer, not shared.
+        self._opt_states = {}  # id(opt) -> [stage, found_inf]
 
     def scale(self, var):
         if not self._enable or self._scale == 1.0:
@@ -162,12 +168,18 @@ class GradScaler:
         per-tensor host round-trip)."""
         if not self._enable:
             return
+        st = self._opt_states.setdefault(id(optimizer), [0, False])
+        if st[0] != 0:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update().")
+        st[0] = 1
         holders = []
         for p in optimizer._parameter_list or []:
             params = p["params"] if isinstance(p, dict) else [p]
             holders.extend(q for q in params if q.grad is not None)
         if not holders:
-            self._found_inf = False
+            st[1] = self._found_inf = False
             return
         grads = [q.grad._value for q in holders]
         scaled, found = _unscale_and_check(
@@ -175,14 +187,21 @@ class GradScaler:
         if self._scale != 1.0:
             for q, g in zip(holders, scaled):
                 q.grad._value = g
-        self._found_inf = found  # device scalar; synced once in step()
+        st[1] = self._found_inf = found  # device scalar; synced in step()
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if bool(self._found_inf):  # the single host sync
+        st = self._opt_states.setdefault(id(optimizer), [0, False])
+        if st[0] == 2:
+            raise RuntimeError(
+                "step() has already been called on this optimizer since "
+                "the last update().")
+        if st[0] == 0:
+            self.unscale_(optimizer)
+        st[0] = 2
+        if bool(st[1]):  # this optimizer's finite check; single host sync
             self._found_inf = True
             self._update_on_inf()
             return
@@ -191,7 +210,8 @@ class GradScaler:
         self._update_on_good()
 
     def update(self):
-        # paddle's separate update(); state already advanced in step()
+        # paddle's separate update(); scale state already advanced in step()
+        self._opt_states.clear()
         return
 
     def minimize(self, optimizer, scaled_loss):

@@ -292,12 +292,22 @@ def dsplit(x, num_or_indices, name=None):
 
 
 def take(x, index, mode="raise", name=None):
-    """reference math.py take — flat-index gather."""
+    """reference math.py take — flat-index gather. mode="raise" bounds-checks
+    eagerly (concrete indices); under jit it degrades to wrap (documented —
+    XLA gathers cannot raise)."""
+    xt, it = _t(x), _t(index)
+    if mode == "raise" and not isinstance(it._value, jax.core.Tracer):
+        n = int(np.prod(xt.shape)) if xt.ndim else 1
+        idx = np.asarray(it._value)
+        if idx.size and (idx.min() < -n or idx.max() >= n):
+            raise IndexError(
+                f"take(): index out of range for tensor of {n} elements")
+
     def fn(v, i):
         return jnp.take(v.reshape(-1), i.astype(jnp.int32),
                         mode="clip" if mode == "clip" else "wrap")
 
-    return _u(fn, "take", x, index)
+    return _u(fn, "take", xt, it)
 
 
 def index_fill(x, index, axis, value, name=None):

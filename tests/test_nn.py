@@ -460,6 +460,26 @@ class TestAmp:
         np.testing.assert_array_equal(net.weight.numpy(), w0)  # skipped
         assert scaler._scale < 4.0  # backed off
 
+    def test_grad_scaler_no_double_unscale(self):
+        # unscale_/clip/step pattern: step() must not divide grads by the
+        # scale a second time (reference grad_scaler.py:354-373).
+        net = nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = net(paddle.ones([1, 2])).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        g_after_unscale = net.weight.grad.numpy().copy()
+        scaler.step(opt)  # must NOT unscale again
+        np.testing.assert_allclose(
+            net.weight.grad.numpy(), g_after_unscale, rtol=1e-6)
+        scaler.update()
+        # a second explicit unscale_ before the next update() raises
+        scaler.scale(net(paddle.ones([1, 2])).sum()).backward()
+        scaler.unscale_(opt)
+        with pytest.raises(RuntimeError):
+            scaler.unscale_(opt)
+
     def test_decorate_o2(self):
         net = nn.Sequential(nn.Linear(2, 2), nn.LayerNorm(2))
         paddle.amp.decorate(net, level="O2", dtype="bfloat16")

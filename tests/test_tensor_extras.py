@@ -162,6 +162,9 @@ class TestExtras:
             paddle.take(t, _t(np.array([0, 4]))).numpy(), [0.0, 4.0])
         c = paddle.combinations(_t(np.array([1, 2, 3])), 2)
         assert tuple(c.shape) == (3, 2)
+        # mode="raise" bounds-checks eagerly instead of silently wrapping
+        with pytest.raises(IndexError):
+            paddle.take(t, _t(np.array([0, 99])))
 
     def test_frexp_and_cast(self):
         m, e = paddle.frexp(_t(np.array([4.0], np.float32)))
