@@ -1,9 +1,16 @@
-"""Headline benchmark: GPT-3 decoder training step on one chip.
+"""Benchmarks against the BASELINE.md matrix.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Metric is model FLOPs utilization (MFU) of the full train step
-(fwd+bwd+AdamW) — the BASELINE.md north star is >=45% MFU, so
-vs_baseline = mfu / 0.45.
+Default (driver mode): the headline GPT-3 decoder train-step ladder — prints
+ONE JSON line {"metric", "value", "unit", "vs_baseline"} (MFU; north star
+>=45% so vs_baseline = mfu / 0.45).
+
+BENCH_CONFIG=<rung> runs a single named rung. BENCH_MATRIX=1 runs the
+BASELINE.md matrix (gpt3 headline + llama flashmask + bert-base + resnet50),
+one JSON line per rung, headline line LAST so drivers reading the final line
+still get the headline.
+
+Rungs: gpt3_1p3b gpt3_350m gpt3_125m llama_7bshape bert_base resnet50
+cpu_smoke.
 """
 
 import json
@@ -69,75 +76,9 @@ def _probe_backend(max_tries=2, timeout_s=180.0):
     return None, err
 
 
-def main():
-    backend, init_error = _probe_backend()
-    if backend is None:
-        # Nothing initialized in this process yet; pin to CPU so the smoke
-        # config below cannot touch the wedged tunnel.
-        jax.config.update("jax_platforms", "cpu")
-        backend = "cpu"
-
-    import paddle_tpu as paddle
-    import paddle_tpu.distributed as dist
-    import paddle_tpu.optimizer as opt
-    from paddle_tpu.models import gpt3_1p3b, gpt3_125m, GPTForCausalLM, GPTPretrainingCriterion
-
-    from paddle_tpu.models import gpt3_350m
-
-    on_tpu = backend not in ("cpu",)
-    if init_error:
-        ladder = ["cpu_smoke"]  # degraded: never run a TPU-sized config on host
-    elif os.environ.get("BENCH_CONFIG"):
-        ladder = [os.environ["BENCH_CONFIG"]]
-    elif on_tpu:
-        # try biggest first; a config that cannot compile/fit on this chip
-        # (e.g. 1.3B f32 states > v5e HBM) falls through to the next rung
-        ladder = ["gpt3_1p3b", "gpt3_350m", "gpt3_125m"]
-    else:
-        ladder = ["cpu_smoke"]
-
-    def build(cfg_name):
-        if cfg_name == "gpt3_1p3b":
-            return gpt3_1p3b(max_position_embeddings=2048), 4, 2048, 10
-        if cfg_name == "gpt3_350m":
-            return gpt3_350m(max_position_embeddings=2048), 8, 2048, 10
-        if cfg_name == "gpt3_125m":
-            return gpt3_125m(max_position_embeddings=2048), 8, 2048, 10
-        from paddle_tpu.models import GPTConfig
-        return (GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
-                          vocab_size=8192, max_position_embeddings=512),
-                2, 256, 3)
-
-    fallback_note = None
-    for idx, cfg_name in enumerate(ladder):
-        cfg, batch, seq, steps = build(cfg_name)
-        paddle.seed(0)
-        model = GPTForCausalLM(cfg)
-        crit = GPTPretrainingCriterion(cfg)
-        optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
-        mesh = dist.build_mesh(devices=jax.devices()[:1])
-        # bf16 compute with f32 master weights — the production TPU recipe
-        step = dist.DistributedTrainStep(
-            model, lambda lg, lb: crit(lg, lb), optimizer, mesh=mesh,
-            amp_level="O2" if on_tpu else None, amp_dtype="bfloat16")
-
-        rng = np.random.default_rng(0)
-        ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
-        labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
-        try:
-            loss = step(ids, labels)  # compile + warmup
-            _ = float(loss)
-            break
-        except Exception as e:
-            if idx + 1 >= len(ladder):
-                raise
-            fallback_note = f"{cfg_name} failed ({type(e).__name__}), fell back"
-            dist.env.set_global_mesh(None)
-            continue
-
-    # BENCH_TRACE_DIR=<dir>: bracket the timed steps with the profiler so
-    # the run ships an XLA device trace + host chrome-trace for analysis
-    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+def _timed_steps(step_fn, steps, trace_dir=None):
+    """Warmed-up timed loop; returns seconds/step. step_fn() must return a
+    device value whose float() forces completion."""
     prof = None
     if trace_dir:
         import paddle_tpu.profiler as profiler
@@ -146,36 +87,259 @@ def main():
             device_trace_dir=trace_dir,
             on_trace_ready=profiler.export_chrome_tracing(trace_dir))
         prof.start()
-
     t0 = time.perf_counter()
-    for _i in range(steps):
-        loss = step(ids, labels)
+    last = None
+    for _ in range(steps):
+        last = step_fn()
         if prof is not None:
             prof.step()
-    _ = float(loss)
+    _ = float(last)
     dt = (time.perf_counter() - t0) / steps
     if prof is not None:
         prof.stop()
+    return dt
 
-    n_params = cfg.num_params(include_embeddings=False) + cfg.vocab_size * cfg.hidden_size
-    tokens = batch * seq
-    # 6ND fwd+bwd + attention quadratic term (12*L*h*T^2 per token batch)
-    flops = 6.0 * n_params * tokens + 12.0 * cfg.num_layers * cfg.hidden_size * seq * tokens
+
+def _emit(name, dt, flops, tokens=None, extra=None):
     peak, kind = _peak_flops(jax.devices()[0])
     mfu = flops / dt / peak
     line = {
-        "metric": f"mfu_{cfg_name}_bs{batch}x{seq}_{kind.replace(' ', '_')}",
+        "metric": f"mfu_{name}_{kind.replace(' ', '_')}",
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.45, 4),
-        "tokens_per_sec_per_chip": round(tokens / dt, 1),
         "step_time_s": round(dt, 4),
     }
+    if tokens is not None:
+        line["tokens_per_sec_per_chip"] = round(tokens / dt, 1)
+    if extra:
+        line.update(extra)
+    print(json.dumps(line), flush=True)
+    return line
+
+
+# --------------------------------------------------------------------------- #
+# rungs
+# --------------------------------------------------------------------------- #
+
+
+def _decoder_flops(cfg, batch, seq):
+    """6ND fwd+bwd + attention quadratic term (12*L*h*T^2 per token batch)."""
+    n_params = (cfg.num_params(include_embeddings=False)
+                + cfg.vocab_size * cfg.hidden_size)
+    tokens = batch * seq
+    return (6.0 * n_params * tokens
+            + 12.0 * cfg.num_layers * cfg.hidden_size * seq * tokens)
+
+
+def _decoder_step(cfg, batch, seq, on_tpu, **step_kw):
+    """Shared scaffold: seeded model + criterion + AdamW + single-device mesh
+    + DistributedTrainStep + random token batch. Returns (step, ids, labels)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    mesh = dist.build_mesh(devices=jax.devices()[:1])
+    # bf16 compute with f32 master weights — the production TPU recipe
+    step = dist.DistributedTrainStep(
+        model, lambda lg, lb: crit(lg, lb), optimizer, mesh=mesh,
+        amp_level="O2" if on_tpu else None, amp_dtype="bfloat16", **step_kw)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    return step, ids, labels
+
+
+def run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir=None):
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import gpt3_1p3b, gpt3_125m, gpt3_350m
+
+    def build(name):
+        if name == "gpt3_1p3b":
+            return gpt3_1p3b(max_position_embeddings=2048), 4, 2048, 10
+        if name == "gpt3_350m":
+            return gpt3_350m(max_position_embeddings=2048), 8, 2048, 10
+        if name == "gpt3_125m":
+            return gpt3_125m(max_position_embeddings=2048), 8, 2048, 10
+        from paddle_tpu.models import GPTConfig
+        return (GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
+                          vocab_size=8192, max_position_embeddings=512),
+                2, 256, 3)
+
+    ladder = [cfg_name] if cfg_name else (
+        ["gpt3_1p3b", "gpt3_350m", "gpt3_125m"] if on_tpu else ["cpu_smoke"])
+
+    fallback_note = None
+    for idx, name in enumerate(ladder):
+        cfg, batch, seq, steps = build(name)
+        step, ids, labels = _decoder_step(cfg, batch, seq, on_tpu)
+        try:
+            _ = float(step(ids, labels))  # compile + warmup
+            break
+        except Exception as e:
+            if idx + 1 >= len(ladder):
+                raise
+            fallback_note = f"{name} failed ({type(e).__name__}), fell back"
+            dist.env.set_global_mesh(None)
+            continue
+
+    dt = _timed_steps(lambda: step(ids, labels), steps, trace_dir)
+    flops = _decoder_flops(cfg, batch, seq)
+    extra = {}
     if init_error:
-        line["error"] = f"degraded to cpu: {init_error}"[:400]
+        extra["error"] = f"degraded to cpu: {init_error}"[:400]
     if fallback_note:
-        line["note"] = fallback_note
-    print(json.dumps(line))
+        extra["note"] = fallback_note
+    return _emit(f"{name}_bs{batch}x{seq}", dt, flops, batch * seq, extra)
+
+
+def run_llama_rung(on_tpu):
+    """LLaMA-7B-shape (h=4096, GQA, SwiGLU, RoPE) scaled in depth to fit one
+    chip's optimizer states; flashmask Pallas attention; sharding stage-2 code
+    path (degenerate on 1 chip); BASELINE.md row 'LLaMA-7B/13B sharding +
+    flash_attn'."""
+    from paddle_tpu.models.llama import LlamaConfig, llama_tiny
+
+    if on_tpu:
+        # 7B's matmul shapes (h=4096, f=11008, heads 32/kv 8) at depth 3:
+        # ~0.9B params => ~12.5GB AdamW f32 states on one v5e
+        cfg = LlamaConfig(hidden_size=4096, num_layers=3, num_heads=32,
+                          num_kv_heads=8, intermediate_size=11008,
+                          max_position_embeddings=2048,
+                          attn_variant="flashmask")
+        batch, seq, steps = 4, 2048, 10
+    else:
+        cfg = llama_tiny(attn_variant="flashmask")
+        batch, seq, steps = 2, 128, 3
+    step, ids, labels = _decoder_step(cfg, batch, seq, on_tpu,
+                                      sharding_stage=2)
+    _ = float(step(ids, labels))
+    dt = _timed_steps(lambda: step(ids, labels), steps)
+    return _emit(f"llama_7bshape_flashmask_bs{batch}x{seq}", dt,
+                 _decoder_flops(cfg, batch, seq), batch * seq)
+
+
+def run_bert_rung(on_tpu):
+    """BERT-base MLM+NSP pretraining step (BASELINE.md 'BERT-base / ERNIE-1.0
+    pretraining, fleet data-parallel' — DP collectives are a no-op on one
+    chip; the dp axis is exercised in tests/dryrun)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.bert import (BertForPretraining,
+                                        BertPretrainingCriterion, bert_base,
+                                        bert_tiny)
+
+    if on_tpu:
+        cfg = bert_base()
+        batch, seq, n_mask, steps = 32, 512, 80, 10
+    else:
+        cfg = bert_tiny()
+        batch, seq, n_mask, steps = 2, 128, 8, 3
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_dropout_prob = 0.0
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    mesh = dist.build_mesh(devices=jax.devices()[:1])
+    step = dist.DistributedTrainStep(
+        model, lambda mlm, nsp, ml, nl: crit(mlm, nsp, ml, nl), optimizer,
+        mesh=mesh, amp_level="O2" if on_tpu else None, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    tt = paddle.to_tensor(np.zeros((batch, seq), np.int32))
+    am = paddle.to_tensor(np.ones((batch, seq), np.float32))
+    mpos = paddle.to_tensor(rng.integers(0, seq, (batch, n_mask)))
+    mlab = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, n_mask)))
+    nlab = paddle.to_tensor(rng.integers(0, 2, (batch,)))
+    _ = float(step([ids, tt, am, mpos], [mlab, nlab]))
+    dt = _timed_steps(lambda: step([ids, tt, am, mpos], [mlab, nlab]), steps)
+    h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    # encoder 12h^2/layer params, attention quadratic, + MLM head on n_mask
+    n_enc = 12 * L * h * h
+    flops = (6.0 * n_enc * batch * seq
+             + 12.0 * L * h * seq * batch * seq
+             + 6.0 * batch * n_mask * h * V)
+    return _emit(f"bert_base_bs{batch}x{seq}", dt, flops, batch * seq)
+
+
+def run_resnet_rung(on_tpu):
+    """ResNet-50 ImageNet train step (BASELINE.md first-slice row)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50, resnet18
+
+    if on_tpu:
+        model, batch, hw, steps, fwd_flops = resnet50(), 128, 224, 10, 4.1e9
+    else:
+        model, batch, hw, steps, fwd_flops = resnet18(), 2, 32, 3, 0.04e9
+    paddle.seed(0)
+    optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    mesh = dist.build_mesh(devices=jax.devices()[:1])
+    step = dist.DistributedTrainStep(
+        model, lambda lg, lb: F.cross_entropy(lg, lb), optimizer, mesh=mesh,
+        amp_level="O2" if on_tpu else None, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    img = paddle.to_tensor(rng.normal(size=(batch, 3, hw, hw)).astype(np.float32))
+    lab = paddle.to_tensor(rng.integers(0, 1000, (batch, 1)))
+    _ = float(step(img, lab))
+    dt = _timed_steps(lambda: step(img, lab), steps)
+    flops = 3.0 * fwd_flops * batch  # fwd + ~2x bwd
+    return _emit(f"resnet50_bs{batch}" if on_tpu else f"resnet18_bs{batch}",
+                 dt, flops, extra={"images_per_sec": round(batch / dt, 1)})
+
+
+def main():
+    backend, init_error = _probe_backend()
+    if backend is None:
+        # Nothing initialized in this process yet; pin to CPU so the smoke
+        # config below cannot touch the wedged tunnel.
+        jax.config.update("jax_platforms", "cpu")
+        backend = "cpu"
+    on_tpu = backend not in ("cpu",)
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    cfg_name = os.environ.get("BENCH_CONFIG")
+    matrix = os.environ.get("BENCH_MATRIX")
+
+    if matrix:
+        import paddle_tpu.distributed as dist
+
+        results = []
+        for rung_name, rung in (("llama", run_llama_rung),
+                                ("bert", run_bert_rung),
+                                ("resnet", run_resnet_rung)):
+            try:
+                results.append(rung(on_tpu))
+            except Exception as e:
+                print(json.dumps({"metric": f"{rung_name}_failed",
+                                  "error": f"{type(e).__name__}: {e}"[:300]}),
+                      flush=True)
+            dist.env.set_global_mesh(None)
+        # headline GPT line LAST (drivers read the final line); a degraded
+        # (wedged-tunnel) run must never build a TPU-sized config on host
+        run_gpt_rung("cpu_smoke" if init_error else cfg_name, on_tpu,
+                     init_error, trace_dir)
+        return
+
+    if init_error:
+        cfg_name = "cpu_smoke"  # degraded: never run a TPU-sized config on host
+    if cfg_name == "llama_7bshape":
+        run_llama_rung(on_tpu)
+    elif cfg_name == "bert_base":
+        run_bert_rung(on_tpu)
+    elif cfg_name == "resnet50":
+        run_resnet_rung(on_tpu)
+    else:
+        run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir)
 
 
 if __name__ == "__main__":

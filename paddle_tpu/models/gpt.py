@@ -80,6 +80,11 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     sequence_parallel: bool = False
     use_recompute: bool = False
+    # "flash" = causal Pallas flash attention; "flashmask" = the Pallas
+    # flashmask kernel fed per-key startend row indices (reference:
+    # flashmask_attention, flash_attention.py:1299) — causal by default but
+    # accepts document masks via forward(attn_startend_row_indices=...)
+    attn_variant: str = "flash"
     # context parallelism: shard the sequence over the `sep` mesh axis and use
     # ring attention (paddle_tpu.parallel.ring). TPU-native upgrade over the
     # reference's bare SEP plumbing (segment_parallel.py:26); implies
@@ -156,7 +161,8 @@ class GPTAttention(nn.Layer):
         self.out_proj = RowParallelLinear(config.num_heads * d, h, weight_attr=attr,
                                           has_bias=bias, input_is_parallel=True)
 
-    def forward(self, x, position_ids=None, cache=None, cache_offset=None):
+    def forward(self, x, position_ids=None, cache=None, cache_offset=None,
+                startend_row_indices=None):
         cfg = self.config
         B, S = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([B, S, cfg.num_heads, cfg.head_dim])
@@ -191,6 +197,22 @@ class GPTAttention(nn.Layer):
             k = _constrain(k, P(None, "sep", "mp", None))
             v = _constrain(v, P(None, "sep", "mp", None))
             out = F.ring_flash_attention(q, k, v, causal=True)
+        elif cfg.attn_variant == "flashmask":
+            assert cfg.attention_dropout_prob == 0.0, (
+                "attn_variant='flashmask' does not support attention dropout "
+                "(the flashmask kernel has no dropout path); set "
+                "attention_dropout_prob=0")
+            idx = startend_row_indices
+            if idx is None:
+                # trivial mask (= plain causal) so the flashmask kernel path
+                # is exercised even without document boundaries
+                idx = run_op(
+                    "flashmask_causal_idx",
+                    lambda qq: jnp.full((qq.shape[0], 1, qq.shape[1], 1), S,
+                                        jnp.int32),
+                    [q])
+            out = F.flashmask_attention(
+                q, k, v, startend_row_indices=idx, causal=True)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True,
@@ -263,13 +285,15 @@ class GPTDecoderLayer(nn.Layer):
         self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, position_ids=None, cache=None, cache_offset=None):
+    def forward(self, x, position_ids=None, cache=None, cache_offset=None,
+                startend_row_indices=None):
         residual = x
         h = self.input_layernorm(x)
         if cache is not None:
             h, new_cache = self.self_attn(h, position_ids, cache, cache_offset)
         else:
-            h = self.self_attn(h, position_ids)
+            h = self.self_attn(
+                h, position_ids, startend_row_indices=startend_row_indices)
             new_cache = None
         x = residual + self.dropout(h)
         residual = x
@@ -300,7 +324,8 @@ class GPTModel(nn.Layer):
         self.layers = nn.LayerList([GPTDecoderLayer(config) for _ in range(config.num_layers)])
         self.final_norm = _make_norm(config)
 
-    def forward(self, input_ids, position_ids=None, caches=None, cache_offset=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_offset=None, attn_startend_row_indices=None):
         B, S = input_ids.shape[0], input_ids.shape[1]
         if position_ids is None:
             if caches is not None and cache_offset is not None:
@@ -326,17 +351,25 @@ class GPTModel(nn.Layer):
             h = mark_as_sequence_parallel(h)
         new_caches = [] if caches is not None else None
 
+        if caches is not None and attn_startend_row_indices is not None:
+            raise ValueError(
+                "attn_startend_row_indices is not supported together with KV "
+                "caches: the cached decode path would silently attend across "
+                "document boundaries")
+
         def run_layer(layer, h, cache):
             if cache is not None:
                 return layer(h, position_ids, cache, cache_offset)
-            return layer(h, position_ids)
+            return layer(h, position_ids,
+                         startend_row_indices=attn_startend_row_indices)
 
         for i, layer in enumerate(self.layers):
             cache = caches[i] if caches is not None else None
             if self.config.use_recompute and self.training and cache is None:
                 from ..distributed.fleet.recompute import recompute
 
-                h = recompute(layer, h, position_ids)
+                h = recompute(layer, h, position_ids,
+                              startend_row_indices=attn_startend_row_indices)
             else:
                 out = run_layer(layer, h, cache)
                 if cache is not None:
@@ -366,8 +399,10 @@ class GPTForCausalLM(nn.Layer):
                 weight_attr=_init_attr(config), has_bias=False, gather_output=False,
             )
 
-    def forward(self, input_ids, position_ids=None, caches=None, cache_offset=None):
-        out = self.gpt(input_ids, position_ids, caches, cache_offset)
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_offset=None, attn_startend_row_indices=None):
+        out = self.gpt(input_ids, position_ids, caches, cache_offset,
+                       attn_startend_row_indices=attn_startend_row_indices)
         if caches is not None:
             h, new_caches = out
         else:

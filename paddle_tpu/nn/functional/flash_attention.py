@@ -237,6 +237,10 @@ def flashmask_attention(
     def fn(q, k, v, *rest):
         B, Sq, H, D = q.shape
         Sk = k.shape[1]
+        if k.shape[2] != H:  # GQA/MQA: repeat kv heads
+            rep_kv = H // k.shape[2]
+            k = jnp.repeat(k, rep_kv, axis=2)
+            v = jnp.repeat(v, rep_kv, axis=2)
         rows = jnp.arange(Sq)[:, None]  # query row
         mask_keep = jnp.ones((B, 1, Sq, Sk), bool)
         if has_idx:

@@ -94,9 +94,9 @@ def _candidates(sq, skv, default):
     """Legal (bq, bk) choices: block divides (or covers) the padded seq,
     bounded so the f32 logits tile [bq, bk] stays well under VMEM."""
     cands = {default}
-    for bq in (128, 256):
+    for bq in (128, 256, 512):
         for bk in (128, 256, 512):
-            if bq * bk > 256 * 512:
+            if bq * bk > 512 * 512:
                 continue
             if sq >= bq and skv >= bk:
                 cands.add((bq, bk))

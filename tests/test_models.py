@@ -119,6 +119,28 @@ class TestGPTModel:
         loss.backward()
         assert m.lm_head.weight.grad is not None
 
+    def test_flashmask_variant_matches_flash(self):
+        """attn_variant="flashmask" with no document mask == plain causal."""
+        paddle.seed(0)
+        cfg = gpt3_tiny()
+        m = GPTForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+        ref = m(ids).numpy()
+        m.config.attn_variant = "flashmask"
+        for layer in m.gpt.layers:
+            layer.self_attn.config = m.config
+        out = m(ids).numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+        # document mask (block-diagonal over two 8-token docs) differs from
+        # plain causal and still trains
+        idx = np.full((2, 1, 16, 1), 16, np.int32)
+        idx[:, :, :8] = 8  # keys in doc 0 masked for rows >= 8
+        logits = m(ids, attn_startend_row_indices=paddle.to_tensor(idx))
+        assert not np.allclose(logits.numpy(), ref, atol=1e-3)
+        loss = logits.mean()
+        loss.backward()
+        assert m.gpt.embed_tokens.weight.grad is not None
+
     def test_loss_mask(self):
         paddle.seed(0)
         cfg = gpt3_tiny()

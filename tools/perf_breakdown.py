@@ -1,0 +1,166 @@
+"""Per-component timing breakdown on the current backend (meant for TPU).
+
+Times each suspect in isolation so the 1/MFU budget can be attributed:
+  matmul peak sanity, flash-attention kernel fwd / fwd+bwd (Pallas vs XLA
+  composite), lm-head+CE, MLP-shaped matmuls, full fwd, full train step.
+
+Usage:  python tools/perf_breakdown.py [gpt3_125m|gpt3_350m]
+Prints one JSON line per probe: {"probe", "ms", "tflops", "eff_vs_peak"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, reps=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def report(probe, dt, flops, peak):
+    tf = flops / dt / 1e12
+    print(json.dumps({
+        "probe": probe,
+        "ms": round(dt * 1e3, 2),
+        "tflops": round(tf, 1),
+        "eff_vs_peak": round(flops / dt / peak, 3),
+    }), flush=True)
+
+
+def main():
+    cfg_name = sys.argv[1] if len(sys.argv) > 1 else "gpt3_125m"
+    backend = jax.default_backend()
+    print(json.dumps({"probe": "backend", "name": backend,
+                      "device": str(getattr(jax.devices()[0], "device_kind", ""))}),
+          flush=True)
+    from bench import _peak_flops
+
+    peak, kind = _peak_flops(jax.devices()[0])
+    if backend == "cpu":
+        peak = 1e12  # nominal, so the script still runs for smoke
+
+    B, S = (8, 2048) if backend != "cpu" else (2, 256)
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt3_125m, gpt3_350m, GPTForCausalLM, GPTPretrainingCriterion
+
+    if backend == "cpu":
+        from paddle_tpu.models import gpt3_tiny
+
+        cfg = gpt3_tiny()
+        cfg.max_position_embeddings = S
+    else:
+        cfg = {"gpt3_125m": gpt3_125m, "gpt3_350m": gpt3_350m}[cfg_name](
+            max_position_embeddings=S)
+    H, L, nh, D = cfg.hidden_size, cfg.num_layers, cfg.num_heads, cfg.head_dim
+    V = cfg.vocab_size
+    key = jax.random.PRNGKey(0)
+
+    # 1. matmul peak sanity: can this chip/tunnel hit its spec at all?
+    for n in ((4096, 8192) if backend != "cpu" else (512,)):
+        a = jax.random.normal(key, (n, n), jnp.bfloat16)
+        f = jax.jit(lambda x, y: x @ y)
+        dt = timeit(f, a, a)
+        report(f"matmul_bf16_{n}", dt, 2.0 * n ** 3, peak)
+
+    # 2. MLP-shaped matmul chain (the non-attention compute shape)
+    x = jax.random.normal(key, (B * S, H), jnp.bfloat16)
+    w1 = jax.random.normal(key, (H, 4 * H), jnp.bfloat16)
+    w2 = jax.random.normal(key, (4 * H, H), jnp.bfloat16)
+
+    def mlp(x, w1, w2):
+        return jax.nn.gelu(x @ w1) @ w2
+
+    dt = timeit(jax.jit(mlp), x, w1, w2)
+    report("mlp_fwd", dt, 2 * 2 * B * S * H * 4 * H, peak)
+
+    grad_mlp = jax.jit(jax.grad(lambda x, w1, w2: mlp(x, w1, w2).astype(jnp.float32).sum(),
+                                argnums=(1, 2)))
+    dt = timeit(grad_mlp, x, w1, w2)
+    report("mlp_bwd", dt, 2 * 2 * 2 * B * S * H * 4 * H, peak)
+
+    # 3. attention: Pallas kernel vs XLA composite, fwd and fwd+bwd
+    attn_flops_fwd = 2 * 2 * B * nh * S * S * D  # qk + pv (causal halves it)
+    q = jax.random.normal(key, (B, S, nh, D), jnp.bfloat16)
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+    from paddle_tpu.nn.functional.flash_attention import _ref_attention
+
+    def pal(q):
+        return flash_attention_fwd(q, q, q, causal=True)
+
+    def comp(q):
+        return _ref_attention(q, q, q, causal=True)
+
+    for name, fn in (("attn_pallas", pal), ("attn_xla", comp)):
+        try:
+            dt = timeit(jax.jit(fn), q)
+            report(name + "_fwd", dt, attn_flops_fwd / 2, peak)
+            g = jax.jit(jax.grad(lambda q: fn(q).astype(jnp.float32).sum()))
+            dt = timeit(g, q)
+            report(name + "_fwdbwd", dt, attn_flops_fwd / 2 * 3.5, peak)
+        except Exception as e:
+            print(json.dumps({"probe": name, "error": f"{type(e).__name__}: {e}"[:200]}),
+                  flush=True)
+
+    # 4. lm head + cross entropy (tied-embedding shape)
+    h = jax.random.normal(key, (B, S, H), jnp.bfloat16)
+    w = jax.random.normal(key, (V, H), jnp.bfloat16)
+    lab = jax.random.randint(key, (B, S), 0, V)
+
+    def head_ce(h, w, lab):
+        logits = h @ w.T
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, lab[..., None], axis=-1).mean()
+
+    dt = timeit(jax.jit(head_ce), h, w, lab)
+    report("head_ce_fwd", dt, 2 * B * S * H * V, peak)
+    g = jax.jit(jax.grad(head_ce, argnums=(0, 1)))
+    dt = timeit(g, h, w, lab)
+    report("head_ce_fwdbwd", dt, 3 * 2 * B * S * H * V, peak)
+
+    # 5. full model fwd and full train step
+    paddle.seed(0)
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    mesh = dist.build_mesh(devices=jax.devices()[:1])
+    step = dist.DistributedTrainStep(
+        model, lambda lg, lb: crit(lg, lb), optimizer, mesh=mesh,
+        amp_level="O2", amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, V, (B, S)))
+    labels = paddle.to_tensor(rng.integers(0, V, (B, S)))
+
+    n_params = cfg.num_params(include_embeddings=False) + V * H
+    tok = B * S
+    step_flops = 6.0 * n_params * tok + 12.0 * L * H * S * tok
+
+    def run_step(_i):
+        return step(ids, labels)
+
+    dt = timeit(lambda: step(ids, labels)._value, reps=5, warmup=2)
+    report("train_step", dt, step_flops, peak)
+
+    # 6. eval (fwd-only) pass through the same machinery
+    dt = timeit(lambda: step.evaluate(ids, labels)._value, reps=5, warmup=2)
+    report("eval_fwd", dt, step_flops / 3.0, peak)
+
+
+if __name__ == "__main__":
+    main()
