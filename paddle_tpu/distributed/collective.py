@@ -192,16 +192,17 @@ def _is_stacked(tensor, group):
     return tensor.ndim >= 1 and tensor.shape[0] == group.nranks
 
 
-def _mp_active(group):
+def _mp_active(group, allow_subgroup=False):
     """The cross-process eager backend when jax.distributed has N > 1
     controllers (multi-controller CPU/TPU pods), else None. Subgroup eager
-    collectives are refused rather than silently wrong."""
+    collectives are refused rather than silently wrong, except where the
+    caller has a subgroup implementation (allow_subgroup)."""
     from . import eager_multiproc as mp
 
     n = mp.nprocs()
     if n <= 1:
         return None
-    if group.nranks not in (n,):
+    if group.nranks not in (n,) and not allow_subgroup:
         raise NotImplementedError(
             "eager collectives over subgroups are not supported in "
             "multi-process mode; use the compiled shard_map primitives")
@@ -224,10 +225,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _grp(group)
     if g.nranks == 1:
         return _Task(tensor)
-    mp = _mp_active(g)
+    mp = _mp_active(g, allow_subgroup=True)
     if mp is not None:
-        tensor._value = jnp.asarray(
-            mp.allreduce_value(np.asarray(tensor._value), _op_name(op)))
+        if g.nranks == mp.nprocs():
+            tensor._value = jnp.asarray(
+                mp.allreduce_value(np.asarray(tensor._value), _op_name(op)))
+        else:
+            # subgroup (e.g. the mp group of a dp x mp topology): every
+            # process participates in one global gather, then reduces its
+            # own group's rows — SPMD, so all processes must reach this call
+            tensor._value = jnp.asarray(mp.allreduce_value_group(
+                np.asarray(tensor._value), g.ranks, _op_name(op)))
         return _Task(tensor)
     if _is_stacked(tensor, g):
         tensor._value = _reduce_stacked(tensor._value, op, g.nranks)

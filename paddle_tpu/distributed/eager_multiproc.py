@@ -70,6 +70,27 @@ def allreduce_value(v, op="sum"):
     raise ValueError(f"unsupported reduce op {op!r}")
 
 
+def allreduce_value_group(v, ranks, op="sum"):
+    """Subgroup all-reduce built on the global gather: every process
+    contributes (SPMD — all processes must call this collectively, each with
+    its own group), then reduces only its group's rows. Costs one global
+    all-gather, which is fine for the scalar/small reductions (grad norms)
+    the eager subgroup path serves."""
+    g = allgather_values(v)
+    sel = g[np.asarray(sorted(ranks), np.int64)]
+    if op in ("sum",):
+        return sel.sum(axis=0)
+    if op in ("max",):
+        return sel.max(axis=0)
+    if op in ("min",):
+        return sel.min(axis=0)
+    if op in ("prod",):
+        return sel.prod(axis=0)
+    if op in ("avg",):
+        return sel.mean(axis=0)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
 def allgather_objects(obj):
     """Pickle-based object all-gather (reference all_gather_object,
     communication/all_gather.py)."""

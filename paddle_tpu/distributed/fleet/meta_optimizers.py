@@ -2,27 +2,104 @@
 
 Reference: HybridParallelOptimizer (python/paddle/distributed/fleet/
 meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:275) — wraps
-the inner optimizer with TP-aware grad clip and DP/sharding grad sync;
-DygraphShardingOptimizer (dygraph_sharding_optimizer.py:54) — ZeRO-1 param-
-to-rank assignment + post-step broadcast.
+the inner optimizer with a TP/PP-aware ClipGradByGlobalNorm
+(_HybridParallelClipGrad :45) and grad sync; DygraphShardingOptimizer
+(dygraph_sharding_optimizer.py:54) — ZeRO-1 param-to-rank assignment +
+post-step broadcast.
 
-TPU: grad sync and ZeRO sharding are placement properties of the compiled
-train step (DistributedTrainStep), so these wrappers mainly carry API and
-the global-norm clip semantics across the whole (replicated+sharded) param
-set — which the compiled clip already computes globally.
+TPU: inside the compiled train step grad sync / ZeRO sharding are placement
+properties (DistributedTrainStep) and the jit clip is already global. These
+wrappers carry the *eager-path* semantics — in multi-controller eager runs
+(jax.distributed, one process per device group) each process only holds its
+TP shard and its pipeline stage's params, so the global-norm reduction must
+span the mp and pp groups, while replicated params are counted once. In
+single-controller SPMD mode params hold global values, and the collective
+calls below are placement-transparent no-ops.
 """
 
 from __future__ import annotations
 
+from ...framework.core import Tensor, no_grad
+from ...nn.clip import ClipGradByGlobalNorm
 
 __all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]
 
 
+class _HybridParallelClipGrad:
+    """Distributed ClipGradByGlobalNorm (reference
+    hybrid_parallel_optimizer.py:45 _HybridParallelClipGrad._dygraph_clip):
+
+    ||g||^2 = mp_allreduce(sum of TP-sharded sq) + sum of replicated sq,
+    then pp_allreduce(total) when pipeline stages own disjoint params.
+    TP-duplicate handling: params with is_distributed=True are genuinely
+    sharded (each mp rank holds distinct rows/cols — their local sq sums),
+    while replicated params appear identically on every mp rank and must be
+    counted exactly once, so only the distributed part rides the mp reduce.
+    """
+
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+        self.clip_norm = clip.clip_norm
+
+    @no_grad()
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        from ..collective import all_reduce
+
+        sq_dist, sq_rep = [], []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            if getattr(p, "is_distributed", False):
+                sq_dist.append(s)
+            else:
+                sq_rep.append(s)
+        if not sq_dist and not sq_rep:
+            return params_grads
+
+        dist_sq = sum(sq_dist) if sq_dist else jnp.zeros(())
+        hcg = self._hcg
+        if hcg is not None and hcg.get_model_parallel_world_size() > 1:
+            t = Tensor(dist_sq)
+            all_reduce(t, group=hcg.get_model_parallel_group())
+            dist_sq = t._value
+        total = dist_sq + (sum(sq_rep) if sq_rep else jnp.zeros(()))
+        if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
+            t = Tensor(total)
+            all_reduce(t, group=hcg.get_pipe_parallel_group())
+            total = t._value
+
+        gnorm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(
+                (g._value.astype(jnp.float32) * scale).astype(g._value.dtype))))
+        return out
+
+
 class HybridParallelOptimizer:
+    """Wraps the user optimizer for hybrid-parallel eager training: swaps a
+    plain ClipGradByGlobalNorm for the mp/pp-aware distributed clip and
+    exposes the deduplicated parameter list (reference
+    _obtain_optimizer_parameters_list :275 — shared embedding/lm-head params
+    appear once)."""
+
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        inner_clip = getattr(optimizer, "_grad_clip", None)
+        self._dist_clip = None
+        if isinstance(inner_clip, ClipGradByGlobalNorm) and hcg is not None:
+            self._dist_clip = _HybridParallelClipGrad(inner_clip, hcg)
+            optimizer._grad_clip = self._dist_clip
 
     @property
     def _learning_rate(self):
@@ -31,8 +108,54 @@ class HybridParallelOptimizer:
     def __getattr__(self, name):
         return getattr(self.__dict__["_inner_opt"], name)
 
+    def _obtain_optimizer_parameters_list(self):
+        """Flat, deduplicated (by identity) parameter list — shared params
+        (tied embeddings across pipeline stages) contribute once."""
+        seen, out = set(), []
+        for p in self._inner_opt._parameter_list or []:
+            params = p["params"] if isinstance(p, dict) else [p]
+            for q in params:
+                if id(q) not in seen:
+                    seen.add(id(q))
+                    out.append(q)
+        return out
+
+    def _deduped_structured(self):
+        """The inner parameter list with duplicate occurrences removed but
+        param-group structure (per-group lr/decay) preserved."""
+        seen, out = set(), []
+        for entry in self._inner_opt._parameter_list or []:
+            if isinstance(entry, dict):
+                kept = []
+                for q in entry["params"]:
+                    if id(q) not in seen:
+                        seen.add(id(q))
+                        kept.append(q)
+                if kept:
+                    e = dict(entry)
+                    e["params"] = kept
+                    out.append(e)
+            elif id(entry) not in seen:
+                seen.add(id(entry))
+                out.append(entry)
+        return out
+
     def step(self):
-        self._inner_opt.step()
+        # a shared param listed twice (tied embedding registered by two
+        # pipeline stages) must be updated ONCE and its grad norm counted
+        # once — run the inner step over the deduplicated list
+        inner = self._inner_opt
+        flat = sum(len(e["params"]) if isinstance(e, dict) else 1
+                   for e in inner._parameter_list or [])
+        if len(self._obtain_optimizer_parameters_list()) != flat:
+            saved = inner._parameter_list
+            inner._parameter_list = self._deduped_structured()
+            try:
+                inner.step()
+            finally:
+                inner._parameter_list = saved
+        else:
+            inner.step()
 
     def clear_grad(self, set_to_zero=True):
         self._inner_opt.clear_grad(set_to_zero)
@@ -56,3 +179,4 @@ class DygraphShardingOptimizer(HybridParallelOptimizer):
     def __init__(self, optimizer, hcg=None, strategy=None):
         super().__init__(optimizer, hcg, strategy)
         self.sharding_stage = 1
+        optimizer._sharding_stage = 1

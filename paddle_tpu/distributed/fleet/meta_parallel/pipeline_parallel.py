@@ -184,9 +184,23 @@ class PipelineParallel:
 
     def _compiled_runner(self):
         if self._compiled_state == 0:
+            import warnings
+
             try:
                 self._compiled = self._build_compiled()
-            except Exception:
+                if self._compiled is None:
+                    warnings.warn(
+                        "PipelineParallel: layer structure is not eligible "
+                        "for the compiled 1F1B schedule (non-uniform stages, "
+                        "shared params, or custom forwards); falling back to "
+                        "the sequential micro-batch loop (no pipelining)",
+                        RuntimeWarning, stacklevel=3)
+            except Exception as e:
+                warnings.warn(
+                    "PipelineParallel: compiled 1F1B schedule could not be "
+                    f"built ({type(e).__name__}: {e}); falling back to the "
+                    "sequential micro-batch loop (no pipelining)",
+                    RuntimeWarning, stacklevel=3)
                 self._compiled = None
             self._compiled_state = 1 if self._compiled is not None else -1
         return self._compiled
@@ -217,7 +231,14 @@ class PipelineParallel:
             if runner is not None:
                 try:
                     loss, set_grads = runner(x._value, y._value, n_micro)
-                except _InfeasibleCompiled:
+                except _InfeasibleCompiled as e:
+                    import warnings
+
+                    warnings.warn(
+                        "PipelineParallel: compiled 1F1B schedule is "
+                        f"infeasible for this model ({e}); falling back to "
+                        "the sequential micro-batch loop (no pipelining)",
+                        RuntimeWarning, stacklevel=2)
                     self._compiled = None
                     self._compiled_state = -1
                 else:
@@ -228,7 +249,7 @@ class PipelineParallel:
                         lr_scheduler.step()
                     return Tensor(loss)
 
-        losses = []
+        loss_acc = None  # device-side accumulation: no host sync per microbatch
         for m in range(n_micro):
             lo, hi = m * mbs, min((m + 1) * mbs, total)
             xm, ym = x[lo:hi], y[lo:hi]
@@ -239,7 +260,8 @@ class PipelineParallel:
                 scaler.scale(scaled).backward()
             else:
                 scaled.backward()
-            losses.append(float(loss.numpy()))
+            ld = loss.detach()
+            loss_acc = ld if loss_acc is None else loss_acc + ld
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -248,7 +270,7 @@ class PipelineParallel:
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return Tensor(np.asarray(np.mean(losses), np.float32))
+        return loss_acc * (1.0 / n_micro)
 
     @no_grad()
     def eval_batch(self, data, compute_loss=True):

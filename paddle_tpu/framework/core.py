@@ -678,17 +678,41 @@ _DISPATCH_CACHE: "_OrderedDict[tuple, tuple]" = _OrderedDict()
 _DISPATCH_CAP = 8192
 _UNCACHEABLE: set = set()  # (name, code) pairs that failed to jit
 _dispatch_stats = {"hits": 0, "misses": 0, "bypass": 0}
+_bypassed_ops: dict = {}  # op name -> eager-bypass count (hot ops visible)
 _dispatch_lock = threading.Lock()
 
 
 def dispatch_cache_stats():
-    return dict(_dispatch_stats)
+    """Cache counters plus the op names that are NOT being cached:
+    "uncacheable_ops" = blacklisted after a failed jit (every call of these
+    retraces eagerly — a hot op here is a perf regression), "bypassed_ops" =
+    per-name eager-bypass counts (unhashable closures, blacklist hits)."""
+    with _dispatch_lock:
+        stats = dict(_dispatch_stats)
+        stats["uncacheable_ops"] = sorted({n for n, _ in _UNCACHEABLE})
+        stats["bypassed_ops"] = dict(_bypassed_ops)
+    return stats
+
+
+def _mark_uncacheable(failed_pair):
+    with _dispatch_lock:
+        if failed_pair in _UNCACHEABLE:
+            return
+        _UNCACHEABLE.add(failed_pair)
+    import warnings
+
+    warnings.warn(
+        f"op '{failed_pair[0]}' could not be jit-compiled and is now "
+        "permanently dispatched eagerly (per-call retrace); see "
+        "dispatch_cache_stats()['uncacheable_ops']",
+        RuntimeWarning, stacklevel=3)
 
 
 def clear_dispatch_cache():
     with _dispatch_lock:
         _DISPATCH_CACHE.clear()
         _UNCACHEABLE.clear()
+        _bypassed_ops.clear()
         _dispatch_stats.update(hits=0, misses=0, bypass=0)
 
 
@@ -898,19 +922,22 @@ def _run_op_impl(name: str, fn: Callable, inputs: Sequence, n_outputs: int | Non
             out = entry[1](*values)
             return _finish_op(name, out, None, entry, tensors, False)
     else:
-        _dispatch_stats["bypass"] += 1
+        with _dispatch_lock:
+            _dispatch_stats["bypass"] += 1
+            if not in_tracing():  # only hot eager calls, not jit-trace passes
+                _bypassed_ops[name] = _bypassed_ops.get(name, 0) + 1
 
     if not need_grad:
         out = fn(*values)
         if failed_pair is not None:
-            _UNCACHEABLE.add(failed_pair)
+            _mark_uncacheable(failed_pair)
         if isinstance(out, tuple):
             return tuple(Tensor(o) for o in out)
         return Tensor(out)
 
     out, vjp_fn = jax.vjp(fn, *values)
     if failed_pair is not None:
-        _UNCACHEABLE.add(failed_pair)
+        _mark_uncacheable(failed_pair)
     return _wrap_grad_outputs(name, out, vjp_fn, tensors)
 
 

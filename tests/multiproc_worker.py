@@ -93,6 +93,65 @@ def main():
     np.testing.assert_allclose(global_losses, local_losses,
                                rtol=2e-4, atol=1e-5)
 
+    # --- eager hybrid-optimizer clip over an mp=world topology ----------- #
+    # reference parity: _HybridParallelClipGrad must reduce TP-sharded sq
+    # sums over the mp group while counting replicated params exactly once,
+    # so the per-rank update equals the single-device full-tensor clip.
+    from paddle_tpu.distributed import fleet
+
+    strat = fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 1, "mp_degree": world}
+    fleet.init(is_collective=True, strategy=strat)
+    from paddle_tpu.framework.core import Parameter
+    import jax.numpy as jnp
+    wd = Parameter(jnp.zeros(4, jnp.float32))
+    wd.is_distributed = True  # rank-distinct shard of a TP weight
+    wr = Parameter(jnp.zeros(2, jnp.float32))
+    g_d = np.arange(4, dtype=np.float32) + 4.0 * rank
+    g_r = np.asarray([6.0, 8.0], np.float32)
+    wd.grad = paddle.to_tensor(g_d.copy())
+    wr.grad = paddle.to_tensor(g_r.copy())
+    inner = opt.SGD(learning_rate=1.0, parameters=[wd, wr],
+                    grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    hpo = fleet.distributed_optimizer(inner)
+    hpo.step()
+    full_d = np.concatenate([np.arange(4, dtype=np.float32) + 4.0 * r
+                             for r in range(world)])
+    gn = np.sqrt((full_d ** 2).sum() + (g_r ** 2).sum())
+    scale = 1.0 / max(gn, 1.0)
+    np.testing.assert_allclose(wd.numpy(), -g_d * scale, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(wr.numpy(), -g_r * scale, rtol=1e-5, atol=1e-6)
+    dist.env.set_global_mesh(None)
+
+    # --- hybrid dp x mp: the mp group is a SUBGROUP of the world, so the
+    # distributed clip's reduction rides allreduce_value_group ------------- #
+    if world >= 4 and world % 2 == 0:
+        mp_deg = world // 2
+        strat2 = fleet.DistributedStrategy()
+        strat2.hybrid_configs = {"dp_degree": 2, "mp_degree": mp_deg}
+        fleet.init(is_collective=True, strategy=strat2)
+        hcg = fleet.get_hybrid_communicate_group()
+        mp_rank = hcg.get_model_parallel_rank()
+        wd2 = Parameter(jnp.zeros(4, jnp.float32))
+        wd2.is_distributed = True
+        wr2 = Parameter(jnp.zeros(2, jnp.float32))
+        g_d2 = np.arange(4, dtype=np.float32) + 4.0 * mp_rank
+        wd2.grad = paddle.to_tensor(g_d2.copy())
+        wr2.grad = paddle.to_tensor(g_r.copy())
+        inner2 = opt.SGD(learning_rate=1.0, parameters=[wd2, wr2],
+                         grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        hpo2 = fleet.distributed_optimizer(inner2)
+        hpo2.step()
+        full2 = np.concatenate([np.arange(4, dtype=np.float32) + 4.0 * r
+                                for r in range(mp_deg)])
+        gn2 = np.sqrt((full2 ** 2).sum() + (g_r ** 2).sum())
+        s2 = 1.0 / max(gn2, 1.0)
+        np.testing.assert_allclose(wd2.numpy(), -g_d2 * s2,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(wr2.numpy(), -g_r * s2,
+                                   rtol=1e-5, atol=1e-6)
+        dist.env.set_global_mesh(None)
+
     print(json.dumps({"rank": rank, "losses": global_losses}), flush=True)
 
 

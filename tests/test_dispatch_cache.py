@@ -87,19 +87,29 @@ def test_grad_path_cached_and_correct():
 
 
 def test_unjittable_op_falls_back():
+    import warnings
+
     x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
 
     def host_round_trip(a):
         # np.asarray on a tracer raises -> not jittable, must fall back
         return paddle.framework.core.jnp.asarray(np.asarray(a) * 2.0)
 
-    out = run_op("host_round_trip", host_round_trip, [x])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = run_op("host_round_trip", host_round_trip, [x])
     np.testing.assert_allclose(out.numpy(), [2.0, -4.0, 6.0])
-    # second call: bypassed (blacklisted), still correct
+    # blacklisting is announced once, with the op name
+    assert any("host_round_trip" in str(w.message) for w in caught)
+    # second call: bypassed (blacklisted), still correct, and the op is
+    # visible by NAME in the stats so the regression is findable
     b = dispatch_cache_stats()["bypass"]
     out2 = run_op("host_round_trip", host_round_trip, [x])
     np.testing.assert_allclose(out2.numpy(), [2.0, -4.0, 6.0])
-    assert dispatch_cache_stats()["bypass"] > b
+    stats = dispatch_cache_stats()
+    assert stats["bypass"] > b
+    assert "host_round_trip" in stats["uncacheable_ops"]
+    assert stats["bypassed_ops"].get("host_round_trip", 0) >= 1
 
 
 def test_inplace_and_hooks_still_work():

@@ -390,3 +390,58 @@ class TestMixPrecisionUtils:
             assert inner.weight.main_grad is None  # cleared with grads
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]
+
+
+class TestHybridParallelOptimizer:
+    def test_distributed_clip_single_controller_matches_plain(self):
+        """On a single-controller 2-mp mesh params hold global values, so the
+        distributed clip must equal the plain ClipGradByGlobalNorm result
+        (mp reduction is a placement no-op; replicated params counted once)."""
+        from paddle_tpu.framework.core import Parameter
+        import paddle_tpu.optimizer as opt
+
+        strat = fleet.DistributedStrategy()
+        strat.hybrid_configs = {"dp_degree": 1, "mp_degree": 2}
+        fleet.init(is_collective=True, strategy=strat)
+
+        def build():
+            wd = Parameter(jnp.zeros((4,), jnp.float32))
+            wd.is_distributed = True
+            wr = Parameter(jnp.zeros((2,), jnp.float32))
+            wd.grad = paddle.to_tensor(np.arange(4, dtype=np.float32))
+            wr.grad = paddle.to_tensor(np.asarray([6.0, 8.0], np.float32))
+            return wd, wr
+
+        wd1, wr1 = build()
+        inner = opt.SGD(learning_rate=1.0, parameters=[wd1, wr1],
+                        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        hpo = fleet.distributed_optimizer(inner)
+        assert hpo._dist_clip is not None, "global-norm clip not wrapped"
+        hpo.step()
+
+        wd2, wr2 = build()
+        plain = opt.SGD(learning_rate=1.0, parameters=[wd2, wr2],
+                        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        plain.step()
+        np.testing.assert_allclose(wd1.numpy(), wd2.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(wr1.numpy(), wr2.numpy(), rtol=1e-6)
+
+    def test_param_list_dedup(self):
+        from paddle_tpu.framework.core import Parameter
+        import paddle_tpu.optimizer as opt
+
+        strat = fleet.DistributedStrategy()
+        strat.hybrid_configs = {"dp_degree": 1, "mp_degree": 2}
+        fleet.init(is_collective=True, strategy=strat)
+        shared = Parameter(jnp.zeros((2,), jnp.float32))
+        other = Parameter(jnp.zeros((2,), jnp.float32))
+        inner = opt.SGD(learning_rate=1.0,
+                        parameters=[shared, other, shared])
+        hpo = fleet.distributed_optimizer(inner)
+        assert len(hpo._obtain_optimizer_parameters_list()) == 2
+        # the twice-listed (tied) param is updated exactly ONCE per step
+        shared.grad = paddle.to_tensor(np.ones(2, np.float32))
+        other.grad = paddle.to_tensor(np.ones(2, np.float32))
+        hpo.step()
+        np.testing.assert_allclose(shared.numpy(), -1.0)
+        np.testing.assert_allclose(other.numpy(), -1.0)

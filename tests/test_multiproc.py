@@ -59,7 +59,7 @@ def _spawn_world(world, timeout=300):
     return procs, outs
 
 
-@pytest.mark.parametrize("world", [2])
+@pytest.mark.parametrize("world", [2, 4])
 def test_multiprocess_collectives_and_dp_parity(world):
     procs, outs = _spawn_world(world)
     for p, out in zip(procs, outs):

@@ -483,7 +483,14 @@ class TestPipelineLayerWrapper:
         rng = np.random.default_rng(2)
         x = paddle.to_tensor(np.asarray(rng.normal(size=(4, 16)), np.float32))
         y = paddle.to_tensor(np.asarray(rng.normal(size=(4, 16)), np.float32))
-        loss = pp.train_batch((x, y), optimizer)
+        import warnings
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            loss = pp.train_batch((x, y), optimizer)
         dist.env.set_global_mesh(None)
         assert pp._compiled_state == -1, "nonuniform stages must not compile"
+        # the downgrade to the sequential loop must be announced, not silent
+        assert any("falling back" in str(w.message)
+                   and issubclass(w.category, RuntimeWarning) for w in caught)
         assert np.isfinite(float(loss.numpy()))
