@@ -231,11 +231,14 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             tensor._value = jnp.asarray(
                 mp.allreduce_value(np.asarray(tensor._value), _op_name(op)))
         else:
-            # subgroup (e.g. the mp group of a dp x mp topology): every
-            # process participates in one global gather, then reduces its
-            # own group's rows — SPMD, so all processes must reach this call
-            tensor._value = jnp.asarray(mp.allreduce_value_group(
-                np.asarray(tensor._value), g.ranks, _op_name(op)))
+            # subgroup (new_group semantics / the mp group of a dp x mp
+            # topology): member-only reduce over the TCPStore — non-members
+            # are not involved, so member-only call patterns are safe
+            from .store import create_or_get_global_tcp_store
+
+            tensor._value = jnp.asarray(mp.store_allreduce_group(
+                create_or_get_global_tcp_store(), np.asarray(tensor._value),
+                g.ranks, _op_name(op), gid=g.id))
         return _Task(tensor)
     if _is_stacked(tensor, g):
         tensor._value = _reduce_stacked(tensor._value, op, g.nranks)

@@ -55,8 +55,9 @@ def allgather_values(v):
     return np.asarray(_mu().process_allgather(np.asarray(v), tiled=False))
 
 
-def allreduce_value(v, op="sum"):
-    g = allgather_values(v)
+def _reduce_rows(g, op):
+    """Reduce a stacked [n, ...] array over its leading axis — the single
+    reduce-op dispatch shared by every eager reduction path."""
     if op in ("sum",):
         return g.sum(axis=0)
     if op in ("max",):
@@ -70,25 +71,41 @@ def allreduce_value(v, op="sum"):
     raise ValueError(f"unsupported reduce op {op!r}")
 
 
-def allreduce_value_group(v, ranks, op="sum"):
-    """Subgroup all-reduce built on the global gather: every process
-    contributes (SPMD — all processes must call this collectively, each with
-    its own group), then reduces only its group's rows. Costs one global
-    all-gather, which is fine for the scalar/small reductions (grad norms)
-    the eager subgroup path serves."""
-    g = allgather_values(v)
-    sel = g[np.asarray(sorted(ranks), np.int64)]
-    if op in ("sum",):
-        return sel.sum(axis=0)
-    if op in ("max",):
-        return sel.max(axis=0)
-    if op in ("min",):
-        return sel.min(axis=0)
-    if op in ("prod",):
-        return sel.prod(axis=0)
-    if op in ("avg",):
-        return sel.mean(axis=0)
-    raise ValueError(f"unsupported reduce op {op!r}")
+def allreduce_value(v, op="sum"):
+    return _reduce_rows(allgather_values(v), op)
+
+
+_group_seq: dict = {}
+
+
+def store_allreduce_group(store, v, ranks, op="sum", gid=None):
+    """MEMBER-ONLY subgroup all-reduce over the TCPStore: each member posts
+    its value under a sequenced group key, waits for all members' posts, and
+    reduces. Non-members never participate (unlike the jax.distributed
+    gather, which is a global collective), so member-only calls — the
+    reference's new_group semantics — cannot deadlock the world, and
+    different groups may reduce different shapes concurrently.
+
+    Cleanup: a member's round-(s-2) key is deleted when it enters round s —
+    by then every peer has posted round s-1, which required completing its
+    round-(s-2) reads."""
+    ranks = sorted(int(r) for r in ranks)
+    # gid distinguishes two communicators with identical membership
+    # (new_group called twice) — their reductions must not cross-mix
+    tag = ",".join(map(str, ranks)) + (f"#g{gid}" if gid is not None else "")
+    seq = _group_seq.get(tag, 0)
+    _group_seq[tag] = seq + 1
+    me = rank()
+    store.set(f"gar/{tag}/{seq}/{me}", pickle.dumps(np.asarray(v)))
+    keys = [f"gar/{tag}/{seq}/{r}" for r in ranks]
+    store.wait(keys)
+    vals = np.stack([pickle.loads(store.get(k)) for k in keys])
+    if seq >= 2:
+        try:
+            store.delete_key(f"gar/{tag}/{seq - 2}/{me}")
+        except Exception:
+            pass
+    return _reduce_rows(vals, op)
 
 
 def allgather_objects(obj):

@@ -52,13 +52,18 @@ class _HybridParallelClipGrad:
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 continue
+            if not getattr(p, "is_firstly_shared", True):
+                # non-owner copy of a cross-stage tied weight: its norm is
+                # counted by the owning stage (reference shared-param flag)
+                continue
             s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
             if getattr(p, "is_distributed", False):
                 sq_dist.append(s)
             else:
                 sq_rep.append(s)
-        if not sq_dist and not sq_rep:
-            return params_grads
+        # NO early return on empty: in multi-controller runs the mp/pp
+        # reductions below are collectives every rank must enter, even a
+        # rank whose stage holds only frozen params (its contribution is 0)
 
         dist_sq = sum(sq_dist) if sq_dist else jnp.zeros(())
         hcg = self._hcg

@@ -71,6 +71,41 @@ class PipelineLayer(nn.Layer):
         self.run_funcs = built
         self._layer_list = nn.LayerList([l for l, _ in built if isinstance(l, nn.Layer)])
         self.segment_parts = self._partition(len(built), self._num_stages)
+        self._mark_shared_ownership()
+
+    def _mark_shared_ownership(self):
+        """Shared-param convention (reference PipelineLayer shared_layers /
+        is_firstly_shared): in multi-controller runs, only the stage that
+        FIRST declares a shared layer owns it for distributed grad-norm
+        accounting — other stages' copies get is_firstly_shared=False so
+        _HybridParallelClipGrad counts the tied weight exactly once across
+        the pp group."""
+        if not self._shared:
+            return
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return  # single controller: one object, counted once anyway
+            from .. import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
+            local_stage = hcg.get_stage_id() if hcg is not None else None
+        except Exception:
+            return
+        if local_stage is None:
+            return
+        name_owner = {}
+        for i, d in enumerate(self.descs):
+            if isinstance(d, SharedLayerDesc) and d.layer_name not in name_owner:
+                stage = next(
+                    s for s in range(self._num_stages)
+                    if self.segment_parts[s] <= i < self.segment_parts[s + 1])
+                name_owner[d.layer_name] = stage
+        for name, layer in self._shared.items():
+            owned = name_owner.get(name, 0) == local_stage
+            for p in layer.parameters():
+                p.is_firstly_shared = owned
 
     @staticmethod
     def _partition(n_layers, n_stages):

@@ -350,3 +350,28 @@ def _kl_bern_bern(p, q):
             jnp.log1p(-a) - jnp.log1p(-b))
 
     return run_op("kl_bernoulli", fn, [p.probs_t, q.probs_t])
+
+
+# zoo tail + transforms (import at the end: they subclass Distribution and
+# register KLs against the classes above)
+from .extras import (  # noqa: E402,F401
+    Beta, Gamma, Dirichlet, Laplace, LogNormal, Multinomial, Geometric,
+    Gumbel, Cauchy, Poisson, StudentT, Binomial,
+)
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    TransformedDistribution,
+)
+
+__all__ += [
+    "Beta", "Gamma", "Dirichlet", "Laplace", "LogNormal", "Multinomial",
+    "Geometric", "Gumbel", "Cauchy", "Poisson", "StudentT", "Binomial",
+    "transform", "Transform", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform",
+    "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform", "TransformedDistribution",
+]

@@ -1,0 +1,634 @@
+"""Distribution-zoo tail (reference: python/paddle/distribution/ beta.py,
+gamma.py, dirichlet.py, laplace.py, lognormal.py, multinomial.py,
+geometric.py, gumbel.py, cauchy.py, poisson.py, binomial.py, student_t.py).
+
+Same TPU formulation as the core zoo: sampling is a pure function of
+(framework-RNG key, params) so rsample is reparameterized where the math
+allows (jax.random's gamma/beta/dirichlet implement implicit
+reparameterization), and every density is a differentiable run_op."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma, gammainc, gammaln, xlogy
+
+from ..framework import random as rnd
+from ..framework.core import Tensor, run_op
+from . import Distribution, Normal, _f32, _t, register_kl
+
+__all__ = [
+    "Beta", "Gamma", "Dirichlet", "Laplace", "LogNormal", "Multinomial",
+    "Geometric", "Gumbel", "Cauchy", "Poisson", "StudentT", "Binomial",
+]
+
+
+class Beta(Distribution):
+    """reference: distribution/beta.py Beta(alpha, beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _f32(alpha)
+        self.beta = _f32(beta)
+        shape = jnp.broadcast_shapes(self.alpha._value.shape,
+                                     self.beta._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return run_op("beta_mean", lambda a, b: a / (a + b),
+                      [self.alpha, self.beta])
+
+    @property
+    def variance(self):
+        def fn(a, b):
+            t = a + b
+            return a * b / (t * t * (t + 1))
+
+        return run_op("beta_var", fn, [self.alpha, self.beta])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(a, b):
+            return jax.random.beta(key, a, b, shape=shp)
+
+        return run_op("beta_rsample", fn, [self.alpha, self.beta])
+
+    def log_prob(self, value):
+        def fn(v, a, b):
+            return (xlogy(a - 1, v) + xlogy(b - 1, 1 - v) - betaln(a, b))
+
+        return run_op("beta_log_prob", fn,
+                      [_f32(value), self.alpha, self.beta])
+
+    def entropy(self):
+        def fn(a, b):
+            t = a + b
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b) + (t - 2) * digamma(t))
+
+        return run_op("beta_entropy", fn, [self.alpha, self.beta])
+
+
+class Gamma(Distribution):
+    """reference: distribution/gamma.py Gamma(concentration, rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _f32(concentration)
+        self.rate = _f32(rate)
+        shape = jnp.broadcast_shapes(self.concentration._value.shape,
+                                     self.rate._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return run_op("gamma_mean", lambda c, r: c / r,
+                      [self.concentration, self.rate])
+
+    @property
+    def variance(self):
+        return run_op("gamma_var", lambda c, r: c / (r * r),
+                      [self.concentration, self.rate])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(c, r):
+            return jax.random.gamma(key, c, shape=shp) / r
+
+        return run_op("gamma_rsample", fn, [self.concentration, self.rate])
+
+    def log_prob(self, value):
+        def fn(v, c, r):
+            return (xlogy(c, r) + xlogy(c - 1, v) - r * v - gammaln(c))
+
+        return run_op("gamma_log_prob", fn,
+                      [_f32(value), self.concentration, self.rate])
+
+    def entropy(self):
+        def fn(c, r):
+            return c - jnp.log(r) + gammaln(c) + (1 - c) * digamma(c)
+
+        return run_op("gamma_entropy", fn, [self.concentration, self.rate])
+
+    def cdf(self, value):
+        return run_op("gamma_cdf",
+                      lambda v, c, r: gammainc(c, r * v),
+                      [_f32(value), self.concentration, self.rate])
+
+
+class Dirichlet(Distribution):
+    """reference: distribution/dirichlet.py Dirichlet(concentration)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _f32(concentration)
+        shape = self.concentration._value.shape
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return run_op("dirichlet_mean",
+                      lambda c: c / c.sum(-1, keepdims=True),
+                      [self.concentration])
+
+    @property
+    def variance(self):
+        def fn(c):
+            a0 = c.sum(-1, keepdims=True)
+            m = c / a0
+            return m * (1 - m) / (a0 + 1)
+
+        return run_op("dirichlet_var", fn, [self.concentration])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(c):
+            return jax.random.dirichlet(key, c, shape=shp)
+
+        return run_op("dirichlet_rsample", fn, [self.concentration])
+
+    def log_prob(self, value):
+        def fn(v, c):
+            return (xlogy(c - 1, v).sum(-1)
+                    + gammaln(c.sum(-1)) - gammaln(c).sum(-1))
+
+        return run_op("dirichlet_log_prob", fn,
+                      [_f32(value), self.concentration])
+
+    def entropy(self):
+        def fn(c):
+            k = c.shape[-1]
+            a0 = c.sum(-1)
+            lb = gammaln(c).sum(-1) - gammaln(a0)
+            return (lb + (a0 - k) * digamma(a0)
+                    - ((c - 1) * digamma(c)).sum(-1))
+
+        return run_op("dirichlet_entropy", fn, [self.concentration])
+
+
+class Laplace(Distribution):
+    """reference: distribution/laplace.py Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return run_op("laplace_var", lambda s: 2.0 * s * s, [self.scale])
+
+    @property
+    def stddev(self):
+        return run_op("laplace_std",
+                      lambda s: math.sqrt(2.0) * s, [self.scale])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(loc, scale):
+            return loc + scale * jax.random.laplace(key, shp, dtype=loc.dtype)
+
+        return run_op("laplace_rsample", fn, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+
+        return run_op("laplace_log_prob", fn,
+                      [_f32(value), self.loc, self.scale])
+
+    def entropy(self):
+        return run_op("laplace_entropy",
+                      lambda loc, s: jnp.broadcast_to(
+                          1 + jnp.log(2 * s),
+                          jnp.broadcast_shapes(loc.shape, s.shape)),
+                      [self.loc, self.scale])
+
+    def cdf(self, value):
+        def fn(v, loc, s):
+            z = (v - loc) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+        return run_op("laplace_cdf", fn, [_f32(value), self.loc, self.scale])
+
+    def icdf(self, value):
+        def fn(p, loc, s):
+            a = p - 0.5
+            return loc - s * jnp.sign(a) * jnp.log1p(-2 * jnp.abs(a))
+
+        return run_op("laplace_icdf", fn, [_f32(value), self.loc, self.scale])
+
+
+class LogNormal(Distribution):
+    """reference: distribution/lognormal.py LogNormal(loc, scale) — exp of a
+    Normal; equals TransformedDistribution(Normal, ExpTransform)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return run_op("lognormal_mean",
+                      lambda m, s: jnp.exp(m + s * s / 2),
+                      [self.loc, self.scale])
+
+    @property
+    def variance(self):
+        def fn(m, s):
+            s2 = s * s
+            return jnp.expm1(s2) * jnp.exp(2 * m + s2)
+
+        return run_op("lognormal_var", fn, [self.loc, self.scale])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        z = self._base.rsample(shape)
+        return run_op("lognormal_rsample", lambda v: jnp.exp(v), [z])
+
+    def log_prob(self, value):
+        def fn(v, m, s):
+            lv = jnp.log(v)
+            return (-((lv - m) ** 2) / (2 * s * s) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - lv)
+
+        return run_op("lognormal_log_prob", fn,
+                      [_f32(value), self.loc, self.scale])
+
+    def entropy(self):
+        def fn(m, s):
+            return m + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+
+        return run_op("lognormal_entropy", fn, [self.loc, self.scale])
+
+
+class Multinomial(Distribution):
+    """reference: distribution/multinomial.py Multinomial(total_count,
+    probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_t = _f32(probs)
+        shape = self.probs_t._value.shape
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return run_op("multinomial_mean",
+                      lambda p: self.total_count * p / p.sum(-1, keepdims=True),
+                      [self.probs_t])
+
+    @property
+    def variance(self):
+        def fn(p):
+            p = p / p.sum(-1, keepdims=True)
+            return self.total_count * p * (1 - p)
+
+        return run_op("multinomial_var", fn, [self.probs_t])
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+        n = self.total_count
+
+        def fn(p):
+            k = p.shape[-1]
+            logits = jnp.log(p / p.sum(-1, keepdims=True))
+            draws = jax.random.categorical(
+                key, logits, axis=-1, shape=(n,) + shp)  # [n, *shp]
+            onehot = jax.nn.one_hot(draws, k, dtype=p.dtype)
+            return onehot.sum(0)
+
+        return run_op("multinomial_sample", fn, [self.probs_t])
+
+    def log_prob(self, value):
+        def fn(v, p):
+            logp = jnp.log(p / p.sum(-1, keepdims=True))
+            coeff = gammaln(jnp.asarray(self.total_count + 1.0)) - gammaln(
+                v + 1.0).sum(-1)
+            return coeff + (v * logp).sum(-1)
+
+        return run_op("multinomial_log_prob", fn,
+                      [_f32(value), self.probs_t])
+
+
+class Geometric(Distribution):
+    """reference: distribution/geometric.py Geometric(probs) — counts k in
+    {0, 1, ...} of failures before the first success."""
+
+    def __init__(self, probs, name=None):
+        self.probs_t = _f32(probs)
+        super().__init__(batch_shape=self.probs_t._value.shape)
+
+    @property
+    def mean(self):
+        return run_op("geometric_mean", lambda p: (1 - p) / p, [self.probs_t])
+
+    @property
+    def variance(self):
+        return run_op("geometric_var", lambda p: (1 - p) / (p * p),
+                      [self.probs_t])
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(p):
+            u = jax.random.uniform(key, shp, dtype=p.dtype,
+                                   minval=1e-7, maxval=1.0)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        return run_op("geometric_sample", fn, [self.probs_t])
+
+    def log_prob(self, value):
+        def fn(v, p):
+            return xlogy(v, 1 - p) + jnp.log(p)
+
+        return run_op("geometric_log_prob", fn, [_f32(value), self.probs_t])
+
+    def entropy(self):
+        def fn(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+
+        return run_op("geometric_entropy", fn, [self.probs_t])
+
+
+class Gumbel(Distribution):
+    """reference: distribution/gumbel.py Gumbel(loc, scale)."""
+
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return run_op("gumbel_mean",
+                      lambda m, s: m + self._EULER * s,
+                      [self.loc, self.scale])
+
+    @property
+    def variance(self):
+        return run_op("gumbel_var",
+                      lambda s: (math.pi ** 2 / 6.0) * s * s, [self.scale])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(m, s):
+            return m + s * jax.random.gumbel(key, shp, dtype=m.dtype)
+
+        return run_op("gumbel_rsample", fn, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def fn(v, m, s):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return run_op("gumbel_log_prob", fn,
+                      [_f32(value), self.loc, self.scale])
+
+    def entropy(self):
+        return run_op("gumbel_entropy",
+                      lambda m, s: jnp.broadcast_to(
+                          jnp.log(s) + 1 + self._EULER,
+                          jnp.broadcast_shapes(m.shape, s.shape)),
+                      [self.loc, self.scale])
+
+
+class Cauchy(Distribution):
+    """reference: distribution/cauchy.py Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(m, s):
+            return m + s * jax.random.cauchy(key, shp, dtype=m.dtype)
+
+        return run_op("cauchy_rsample", fn, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def fn(v, m, s):
+            z = (v - m) / s
+            return -jnp.log(math.pi * s * (1 + z * z))
+
+        return run_op("cauchy_log_prob", fn,
+                      [_f32(value), self.loc, self.scale])
+
+    def entropy(self):
+        return run_op("cauchy_entropy",
+                      lambda m, s: jnp.broadcast_to(
+                          jnp.log(4 * math.pi * s),
+                          jnp.broadcast_shapes(m.shape, s.shape)),
+                      [self.loc, self.scale])
+
+    def cdf(self, value):
+        def fn(v, m, s):
+            return jnp.arctan((v - m) / s) / math.pi + 0.5
+
+        return run_op("cauchy_cdf", fn, [_f32(value), self.loc, self.scale])
+
+
+class Poisson(Distribution):
+    """reference: distribution/poisson.py Poisson(rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _f32(rate)
+        super().__init__(batch_shape=self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(r):
+            return jax.random.poisson(key, r, shape=shp).astype(r.dtype)
+
+        return run_op("poisson_sample", fn, [self.rate])
+
+    def log_prob(self, value):
+        def fn(v, r):
+            return xlogy(v, r) - r - gammaln(v + 1.0)
+
+        return run_op("poisson_log_prob", fn, [_f32(value), self.rate])
+
+
+class StudentT(Distribution):
+    """reference: distribution/student_t.py StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _f32(df)
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        shape = jnp.broadcast_shapes(self.df._value.shape,
+                                     self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(df, m, s):
+            return m + s * jax.random.t(key, df, shape=shp)
+
+        return run_op("studentt_rsample", fn, [self.df, self.loc, self.scale])
+
+    def log_prob(self, value):
+        def fn(v, df, m, s):
+            z = (v - m) / s
+            return (gammaln((df + 1) / 2) - gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return run_op("studentt_log_prob", fn,
+                      [_f32(value), self.df, self.loc, self.scale])
+
+
+class Binomial(Distribution):
+    """reference: distribution/binomial.py Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_t = _f32(probs)
+        super().__init__(batch_shape=self.probs_t._value.shape)
+
+    @property
+    def mean(self):
+        return run_op("binomial_mean",
+                      lambda p: self.total_count * p, [self.probs_t])
+
+    @property
+    def variance(self):
+        return run_op("binomial_var",
+                      lambda p: self.total_count * p * (1 - p),
+                      [self.probs_t])
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape
+        n = self.total_count
+
+        def fn(p):
+            draws = jax.random.bernoulli(
+                key, p, shape=(n,) + shp)
+            return draws.astype(p.dtype).sum(0)
+
+        return run_op("binomial_sample", fn, [self.probs_t])
+
+    def log_prob(self, value):
+        def fn(v, p):
+            n = float(self.total_count)
+            coeff = (gammaln(jnp.asarray(n + 1.0)) - gammaln(v + 1.0)
+                     - gammaln(n - v + 1.0))
+            return coeff + xlogy(v, p) + xlogy(n - v, 1 - p)
+
+        return run_op("binomial_log_prob", fn, [_f32(value), self.probs_t])
+
+
+# --------------------------------------------------------------------------- #
+# KLs for the new zoo (reference: distribution/kl.py)
+# --------------------------------------------------------------------------- #
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def fn(a1, b1, a2, b2):
+        t1 = a1 + b1
+        return (betaln(a2, b2) - betaln(a1, b1)
+                + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                + (a2 - a1 + b2 - b1) * digamma(t1))
+
+    return run_op("kl_beta", fn, [p.alpha, p.beta, q.alpha, q.beta])
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def fn(c1, r1, c2, r2):
+        return (gammaln(c2) - gammaln(c1) + (c1 - c2) * digamma(c1)
+                + c2 * (jnp.log(r1) - jnp.log(r2)) + c1 * (r2 - r1) / r1)
+
+    return run_op("kl_gamma", fn,
+                  [p.concentration, p.rate, q.concentration, q.rate])
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def fn(c1, c2):
+        a0 = c1.sum(-1)
+        return (gammaln(a0) - gammaln(c1).sum(-1)
+                - gammaln(c2.sum(-1)) + gammaln(c2).sum(-1)
+                + ((c1 - c2) * (digamma(c1)
+                                - digamma(a0)[..., None])).sum(-1))
+
+    return run_op("kl_dirichlet", fn, [p.concentration, q.concentration])
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def fn(m1, s1, m2, s2):
+        d = jnp.abs(m1 - m2)
+        return (jnp.log(s2 / s1) + d / s2
+                + s1 / s2 * jnp.exp(-d / s1) - 1)
+
+    return run_op("kl_laplace", fn, [p.loc, p.scale, q.loc, q.scale])

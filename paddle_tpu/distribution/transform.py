@@ -1,0 +1,355 @@
+"""Bijective transforms + TransformedDistribution (reference:
+python/paddle/distribution/transform.py — Transform base :96,
+AffineTransform :418, ChainTransform :482, ExpTransform :556,
+PowerTransform :700, SigmoidTransform :1176, SoftmaxTransform :1243,
+StackTransform, StickBreakingTransform :1391, TanhTransform :1460,
+transformed_distribution.py TransformedDistribution).
+
+TPU formulation: transforms are pure jnp maps, so forward/inverse and both
+log-det-Jacobians are differentiable and jit-safe; TransformedDistribution
+composes them with any base distribution's log_prob/sample."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op
+from . import Distribution, _f32, _t
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
+]
+
+
+class Transform:
+    """reference: transform.py:96. Subclasses implement _forward, _inverse,
+    _forward_log_det_jacobian over jnp arrays."""
+
+    _codomain_event_rank = 0
+    _domain_event_rank = 0
+
+    def forward(self, x):
+        return run_op(f"{type(self).__name__}_fwd",
+                      lambda v: self._forward(v), [_f32(x)])
+
+    def inverse(self, y):
+        return run_op(f"{type(self).__name__}_inv",
+                      lambda v: self._inverse(v), [_f32(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return run_op(f"{type(self).__name__}_fldj",
+                      lambda v: self._forward_log_det_jacobian(v), [_f32(x)])
+
+    def inverse_log_det_jacobian(self, y):
+        return run_op(
+            f"{type(self).__name__}_ildj",
+            lambda v: -self._forward_log_det_jacobian(self._inverse(v)),
+            [_f32(y)])
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # -- jnp-level implementations -------------------------------------- #
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference :418)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+
+    def _forward(self, x):
+        return self.loc._value + self.scale._value * x
+
+    def _inverse(self, y):
+        return (y - self.loc._value) / self.scale._value
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(
+            jnp.log(jnp.abs(self.scale._value)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference :556)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on x > 0 (reference :700)."""
+
+    def __init__(self, power):
+        self.power = _f32(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power._value)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power._value)
+
+    def _forward_log_det_jacobian(self, x):
+        p = self.power._value
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference :1176)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference :1460)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x)) — stable form
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference AbsTransform; inverse returns the positive
+    branch)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (reference :1243). Not bijective on
+    R^k (softmax is shift-invariant); inverse returns log(y) like the
+    reference."""
+
+    _codomain_event_rank = 1
+    _domain_event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not bijective; no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^k -> open (k+1)-simplex by stick breaking (reference :1391)."""
+
+    _codomain_event_rank = 1
+    _domain_event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.cumsum(
+            jnp.ones_like(x), axis=-1) + 1.0
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zcum = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * jnp.concatenate(
+            [pad, zcum], -1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] - jnp.cumsum(
+            jnp.ones_like(y_crop), axis=-1) + 1.0
+        sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        z = y_crop / jnp.concatenate(
+            [jnp.ones(y_crop.shape[:-1] + (1,), y.dtype), sf[..., :-1]], -1)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        # triangular Jacobian: dy_i/dx_i = z_i (1-z_i) prod_{j<i} (1-z_j)
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), axis=-1) + 1.0
+        xo = x - jnp.log(offset)
+        z = jax.nn.sigmoid(xo)
+        detail = -jax.nn.softplus(-xo) - jax.nn.softplus(xo)  # log z(1-z)
+        csum = jnp.cumsum(jnp.log1p(-z), axis=-1)
+        prev = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype), csum[..., :-1]], -1)
+        return (detail + prev).sum(-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)) (reference :482)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t._forward_log_det_jacobian(x)
+            if total is not None:
+                # an event-rank-reducing step (e.g. StickBreaking) returns a
+                # log-det summed over its event dims; fold the accumulated
+                # per-element terms over those dims before adding
+                while jnp.ndim(total) > jnp.ndim(ldj):
+                    total = total.sum(-1)
+                ldj = ldj + total
+            total = ldj
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` dims as event
+    dims: the log-det sums over them (reference IndependentTransform)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        return ldj.sum(axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    """Event reshape (reference ReshapeTransform)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    """Applies transforms[i] to slice i along `axis` (reference
+    StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, x, method):
+        parts = [
+            getattr(t, method)(xi)
+            for t, xi in zip(self.transforms,
+                             jnp.moveaxis(x, self.axis, 0))
+        ]
+        return jnp.moveaxis(jnp.stack(parts), 0, self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(x, "_forward_log_det_jacobian")
+
+
+class TransformedDistribution(Distribution):
+    """reference: distribution/transformed_distribution.py — base sample
+    pushed through the transform; log_prob via the inverse + log-det."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = (transforms[0] if len(transforms) == 1
+                          else ChainTransform(transforms))
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        t = self.transform
+        x = t.inverse(_f32(value))  # single inverse evaluation
+        base_lp = self.base.log_prob(x)
+
+        def fn(xv, base_lp_at_x):
+            return base_lp_at_x - t._forward_log_det_jacobian(xv)
+
+        return run_op("transformed_log_prob", fn, [x, base_lp])

@@ -577,6 +577,10 @@ class Parameter(Tensor):
 
     __slots__ = ("trainable", "optimize_attr", "is_distributed", "regularizer",
                  "need_clip",
+                 # False on the non-owner copy of a weight tied across
+                 # pipeline stages (reference shared-param convention) so
+                 # distributed grad-norm reductions count it exactly once
+                 "is_firstly_shared",
                  # f32 grad accumulator for the eager mixed-precision path
                  # (fleet/utils/mix_precision_utils.py MixPrecisionLayer)
                  "main_grad", "_register_grad_hook_handle")
@@ -586,6 +590,7 @@ class Parameter(Tensor):
         self.trainable = trainable
         self.optimize_attr = {"learning_rate": 1.0}
         self.is_distributed = False
+        self.is_firstly_shared = True
         self.regularizer = None
         self.need_clip = True
         # distributed placement: a jax PartitionSpec (or None = replicated);

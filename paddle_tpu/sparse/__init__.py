@@ -5,10 +5,11 @@ nn/functional/activation.py; kernels paddle/phi/kernels/sparse/).
 TPU formulation: sparse COO rides on jax.experimental.sparse.BCOO — XLA
 compiles its gather/scatter formulation, which is the right trade on a
 dense-matrix machine (the reference's cuSPARSE segmented kernels have no
-TPU analog; scatter/gather lowering is what the hardware offers). CSR
-construction converts to the same BCOO representation (crows expanded to
-row indices). SparseTensor wraps the BCOO like Tensor wraps jax.Array and
-interoperates with dense Tensors via to_dense()."""
+TPU analog; scatter/gather lowering is what the hardware offers). CSR is a
+real format (SparseCsrTensor keeps crows/cols/values; SpMM/SpMV run as
+gather + segment-sum over the row pointer). SparseTensor wraps the BCOO
+like Tensor wraps jax.Array and interoperates with dense Tensors via
+to_dense()."""
 
 from __future__ import annotations
 
@@ -18,17 +19,19 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
-from ..framework.core import Tensor, to_tensor
+from ..framework.core import Tensor, run_op, to_tensor
 
 __all__ = [
     "sparse_coo_tensor",
     "sparse_csr_tensor",
     "SparseTensor",
+    "SparseCsrTensor",
     "is_same_shape",
     "add",
     "subtract",
     "multiply",
     "matmul",
+    "mv",
     "masked_matmul",
     "transpose",
     "nn",
@@ -79,6 +82,11 @@ class SparseTensor:
     def coalesce(self):
         return SparseTensor(self._bcoo.sum_duplicates())
 
+    def to_sparse_csr(self):
+        if len(self._bcoo.shape) != 2 or self._bcoo.n_dense:
+            raise NotImplementedError("to_sparse_csr: 2-D COO only")
+        return _coo_to_csr(self)
+
     # -- arithmetic ---------------------------------------------------- #
 
     def __add__(self, other):
@@ -121,14 +129,127 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
     return SparseTensor(bcoo)
 
 
-def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
-    """reference: creation.py sparse_csr_tensor — stored as COO (crows
-    expanded), the TPU-friendly layout."""
-    crows = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
-    cols = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
-    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-    indices = np.stack([rows, cols])
-    return sparse_coo_tensor(indices, values, shape, dtype=dtype)
+class SparseCsrTensor:
+    """Real CSR layout (reference: paddle/phi/core/sparse_csr_tensor.h —
+    crows [m+1], cols [nnz], values [nnz, ...]). Kept in CSR rather than
+    converted: spmv/spmm run as a gather + segment-sum over the row
+    pointer, which XLA lowers to the scatter-add formulation that is the
+    TPU-native SpMM (no cuSPARSE analog needed), and crows round-trips
+    exactly for checkpoint parity."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(
+            crows._value if isinstance(crows, Tensor) else np.asarray(crows)
+        ).astype(jnp.int32)
+        self._cols = jnp.asarray(
+            cols._value if isinstance(cols, Tensor) else np.asarray(cols)
+        ).astype(jnp.int32)
+        self._values = (values._value if isinstance(values, Tensor)
+                        else jnp.asarray(np.asarray(values)))
+        self._shape = tuple(int(s) for s in shape)
+        if self._crows.shape[0] != self._shape[0] + 1:
+            raise ValueError(
+                f"crows must have shape [{self._shape[0] + 1}], got "
+                f"{tuple(self._crows.shape)}")
+
+    # -- properties ------------------------------------------------------ #
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    # -- row ids: entry e belongs to row searchsorted(crows, e, right)-1 -- #
+
+    def _row_ids(self):
+        return (jnp.searchsorted(
+            self._crows, jnp.arange(self.nnz, dtype=jnp.int32),
+            side="right") - 1).astype(jnp.int32)
+
+    # -- conversions ----------------------------------------------------- #
+
+    def to_dense(self):
+        m, n = self._shape[0], self._shape[1]
+        dense = jnp.zeros((m, n) + self._values.shape[1:],
+                          self._values.dtype)
+        return Tensor(dense.at[self._row_ids(), self._cols].add(self._values))
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        idx = jnp.stack([self._row_ids(), self._cols])
+        return sparse_coo_tensor(Tensor(idx), Tensor(self._values),
+                                 self._shape)
+
+    def to_sparse_csr(self):
+        return self
+
+    # -- arithmetic ------------------------------------------------------ #
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """reference: creation.py sparse_csr_tensor — true CSR storage."""
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        values = Tensor(jnp.asarray(
+            values._value if isinstance(values, Tensor)
+            else np.asarray(values)).astype(convert_dtype(dtype)))
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def _coo_to_csr(st: "SparseTensor") -> SparseCsrTensor:
+    """COO -> CSR (2-D): sort entries by (row, col), crows by bincount."""
+    b = st._bcoo.sum_duplicates()
+    rows = b.indices[:, 0].astype(jnp.int32)
+    cols = b.indices[:, 1].astype(jnp.int32)
+    m, n = b.shape[0], b.shape[1]
+    order = jnp.lexsort((cols, rows))  # no int32 linearized-key overflow
+    rows, cols, vals = rows[order], cols[order], b.data[order]
+    crows = jnp.concatenate([
+        jnp.zeros(1, jnp.int32),
+        jnp.cumsum(jnp.bincount(rows, length=m)).astype(jnp.int32)])
+    return SparseCsrTensor(Tensor(crows), Tensor(cols), Tensor(vals), b.shape)
 
 
 def is_same_shape(x, y):
@@ -140,7 +261,17 @@ def is_same_shape(x, y):
 # --------------------------------------------------------------------------- #
 
 
+def _csr_binary(x, y, fn_name):
+    """CSR op via COO union, result back in CSR."""
+    xc = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+    yc = y.to_sparse_coo() if isinstance(y, SparseCsrTensor) else y
+    out = globals()[fn_name](xc, yc)
+    return out.to_sparse_csr() if isinstance(out, SparseTensor) else out
+
+
 def add(x, y):
+    if isinstance(x, SparseCsrTensor) or isinstance(y, SparseCsrTensor):
+        return _csr_binary(x, y, "add")
     if isinstance(y, SparseTensor):
         bx, by = _as_bcoo(x), _as_bcoo(y)
         out = jsparse.BCOO(
@@ -154,6 +285,8 @@ def add(x, y):
 
 
 def subtract(x, y):
+    if isinstance(x, SparseCsrTensor) or isinstance(y, SparseCsrTensor):
+        return _csr_binary(x, y, "subtract")
     if isinstance(y, SparseTensor):
         by = _as_bcoo(y)
         neg = jsparse.BCOO((-by.data, by.indices), shape=by.shape)
@@ -163,6 +296,11 @@ def subtract(x, y):
 
 
 def multiply(x, y):
+    if isinstance(x, SparseCsrTensor):
+        if isinstance(y, (int, float)):
+            return SparseCsrTensor(Tensor(x._crows), Tensor(x._cols),
+                                   Tensor(x._values * y), x._shape)
+        return _csr_binary(x, y, "multiply")
     bx = _as_bcoo(x)
     if isinstance(y, SparseTensor):
         # elementwise on matching sparsity: multiply against y's dense form
@@ -183,11 +321,51 @@ def _gather_dense(dense, bcoo):
 
 
 def matmul(x, y):
-    """Sparse @ dense (reference matmul.py; phi/kernels/sparse/matmul_kernel
-    -> here XLA's scatter/gather dot via bcoo_dot_general)."""
-    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
-    out = _as_bcoo(x) @ yv
-    return Tensor(out)
+    """Sparse @ dense (reference matmul.py; phi/kernels/sparse/matmul_kernel).
+    COO rides bcoo_dot_general; CSR is a gather + segment-sum over the row
+    pointer (SpMM) — both lower to XLA scatter/gather dots. Dense outputs go
+    through run_op so eager autograd flows to the dense operand and to the
+    sparse values."""
+    y_t = y if isinstance(y, Tensor) else to_tensor(y)
+    if isinstance(x, SparseCsrTensor):
+        rows, cols, m = x._row_ids(), x._cols, x._shape[0]
+
+        def fn(vals, yv):
+            gathered = vals[:, None] * yv[cols]  # [nnz, n_out]
+            return jax.ops.segment_sum(
+                gathered, rows, num_segments=m).astype(yv.dtype)
+
+        return run_op("csr_spmm", fn, [Tensor(x._values), y_t])
+    bx = _as_bcoo(x)
+
+    def fn(vals, yv):
+        return jsparse.BCOO((vals, bx.indices), shape=bx.shape) @ yv
+
+    return run_op("coo_spmm", fn, [Tensor(bx.data), y_t])
+
+
+def mv(x, vec):
+    """Sparse matrix @ dense vector (reference: sparse/matmul.py mv —
+    phi/kernels/sparse/mv_kernel). SpMV = per-entry gather + segment-sum."""
+    vec_t = vec if isinstance(vec, Tensor) else to_tensor(vec)
+    if isinstance(x, SparseCsrTensor):
+        rows, cols, m = x._row_ids(), x._cols, x._shape[0]
+
+        def fn(vals, vv):
+            return jax.ops.segment_sum(
+                vals * vv[cols], rows, num_segments=m).astype(vv.dtype)
+
+        return run_op("csr_mv", fn, [Tensor(x._values), vec_t])
+    bx = _as_bcoo(x)
+    rows = bx.indices[:, 0].astype(jnp.int32)
+    cols = bx.indices[:, 1]
+    m = bx.shape[0]
+
+    def fn(vals, vv):
+        return jax.ops.segment_sum(
+            vals * vv[cols], rows, num_segments=m).astype(vv.dtype)
+
+    return run_op("coo_mv", fn, [Tensor(bx.data), vec_t])
 
 
 def masked_matmul(x, y, mask):
@@ -204,6 +382,8 @@ def masked_matmul(x, y, mask):
 
 
 def transpose(x, perm):
+    if isinstance(x, SparseCsrTensor):
+        return transpose(x.to_sparse_coo(), perm).to_sparse_csr()
     bx = _as_bcoo(x)
     return SparseTensor(jsparse.bcoo_transpose(bx, permutation=tuple(perm)))
 
@@ -215,6 +395,10 @@ def transpose(x, perm):
 
 class _SparseReLU:
     def __call__(self, x):
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(Tensor(x._crows), Tensor(x._cols),
+                                   Tensor(jnp.maximum(x._values, 0)),
+                                   x._shape)
         bx = _as_bcoo(x)
         return SparseTensor(jsparse.BCOO(
             (jnp.maximum(bx.data, 0), bx.indices), shape=bx.shape))
@@ -252,3 +436,30 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# dense -> sparse conversions as Tensor methods (reference:
+# python/paddle/tensor/to_string.py Tensor.to_sparse_coo / method patching)
+def _dense_to_sparse_coo(self, sparse_dim=None):
+    """sparse_dim < ndim yields hybrid COO: [sparse_dim, nnz] indices with
+    dense trailing dims in the values (the reference layout)."""
+    v = self._value
+    sd = v.ndim if sparse_dim is None else int(sparse_dim)
+    mask = v != 0
+    if sd < v.ndim:
+        mask = mask.any(axis=tuple(range(sd, v.ndim)))
+    idx = jnp.stack(jnp.nonzero(mask, size=int(np.sum(np.asarray(mask)))))
+    vals = v[tuple(idx)]
+    return sparse_coo_tensor(Tensor(idx), Tensor(vals), v.shape)
+
+
+def _dense_to_sparse_csr(self):
+    if self._value.ndim != 2:
+        raise NotImplementedError("to_sparse_csr: 2-D tensors only")
+    return _dense_to_sparse_coo(self).to_sparse_csr()
+
+
+from ..framework.core import register_tensor_method  # noqa: E402
+
+register_tensor_method("to_sparse_coo", _dense_to_sparse_coo)
+register_tensor_method("to_sparse_csr", _dense_to_sparse_csr)

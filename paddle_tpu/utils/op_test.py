@@ -1,0 +1,206 @@
+"""OpTest harness: systematic fwd-vs-NumPy + VJP-vs-finite-difference checks
+across dtypes, eager and jitted.
+
+Reference analog: test/legacy_test/op_test.py:418 (check_output /
+check_grad) — the reference runs every op kernel against a NumPy model and
+finite-difference gradients across fp32/fp64/fp16/bf16. Here one generic
+harness covers the registry in tests/test_optest_sweep.py.
+
+Checks per OpSpec:
+- forward vs a NumPy reference, f32 eager + f32 under jax.jit + bf16 eager
+  (bf16 compared at bf16-resolution tolerance)
+- VJP vs central finite differences in f32
+- bf16 VJP vs the f32 VJP (bf16 grads are computed, finite, and close)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["InSpec", "OpSpec", "check_forward", "check_grad",
+           "check_forward_jit", "run_all_checks"]
+
+
+@dataclasses.dataclass
+class InSpec:
+    shape: tuple = (3, 4)
+    dtype: str = "float"  # "float" | "int" | "bool"
+    low: float = -2.0
+    high: float = 2.0
+    # keep |x| away from non-differentiable / unstable points (|x|>eps)
+    avoid_zero: bool = False
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str
+    fn: Callable  # (*jnp arrays, **kwargs) -> jnp array (first output used)
+    ref: Callable  # (*np arrays, **kwargs) -> np array
+    inputs: Sequence[InSpec] = (InSpec(),)
+    kwargs: dict = dataclasses.field(default_factory=dict)
+    check_grad: bool = True
+    check_jit: bool = True  # False for value-dependent-shape (eager-only) ops
+    check_bf16: bool = True  # False where no bf16 kernel exists (LAPACK ops)
+    grad_args: Sequence[int] | None = None  # default: all float inputs
+    rtol: float = 2e-5
+    atol: float = 2e-5
+    bf16_rtol: float = 4e-2
+    bf16_atol: float = 4e-2
+    fd_eps: float = 1e-3
+    fd_rtol: float = 8e-2
+    fd_atol: float = 8e-2
+
+
+def make_inputs(spec: OpSpec, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for ins in spec.inputs:
+        if ins.dtype == "int":
+            out.append(rng.integers(int(ins.low), int(ins.high),
+                                    ins.shape).astype(np.int32))
+        elif ins.dtype == "bool":
+            out.append(rng.random(ins.shape) > 0.5)
+        else:
+            v = rng.uniform(ins.low, ins.high, ins.shape)
+            if ins.avoid_zero:
+                v = np.where(np.abs(v) < 0.3, np.sign(v) * 0.3 + (v == 0) * 0.3, v)
+            out.append(v.astype(dtype))
+    return out
+
+
+def _first(out):
+    if isinstance(out, (tuple, list)):
+        return out[0]
+    return out
+
+
+def _apply(spec, vals):
+    out = spec.fn(*[jnp.asarray(v) for v in vals], **spec.kwargs)
+    if isinstance(out, Tensor):
+        out = out._value
+    elif isinstance(out, (tuple, list)):
+        out = _first([o._value if isinstance(o, Tensor) else o for o in out])
+    return out
+
+
+def check_forward(spec: OpSpec, dtype=np.float32):
+    """Eager forward vs the NumPy reference at `dtype`."""
+    vals = make_inputs(spec, np.float32)
+    ref = _first(spec.ref(*[np.asarray(v) for v in vals], **spec.kwargs))
+    if dtype == np.float32:
+        got = _apply(spec, vals)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), np.asarray(ref, np.float64),
+            rtol=spec.rtol, atol=spec.atol,
+            err_msg=f"{spec.name}: f32 eager forward != numpy ref")
+    else:  # bf16: inputs cast to bf16, compared at bf16 resolution
+        bvals = [jnp.asarray(v).astype(jnp.bfloat16)
+                 if np.issubdtype(np.asarray(v).dtype, np.floating) else v
+                 for v in vals]
+        got = _apply(spec, bvals)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), np.asarray(ref, np.float64),
+            rtol=spec.bf16_rtol, atol=spec.bf16_atol,
+            err_msg=f"{spec.name}: bf16 eager forward != numpy ref")
+
+
+def check_forward_jit(spec: OpSpec):
+    """The same op under jax.jit must match its eager output exactly-ish."""
+    vals = make_inputs(spec, np.float32)
+    eager = _apply(spec, vals)
+
+    jitted = jax.jit(lambda *v: _apply(spec, v))
+    got = jitted(*[jnp.asarray(v) for v in vals])
+    np.testing.assert_allclose(
+        np.asarray(got, np.float64), np.asarray(eager, np.float64),
+        rtol=1e-6, atol=1e-6,
+        err_msg=f"{spec.name}: jit forward != eager forward")
+
+
+def _grad_args(spec, vals):
+    if spec.grad_args is not None:
+        return list(spec.grad_args)
+    return [i for i, v in enumerate(vals)
+            if np.issubdtype(np.asarray(v).dtype, np.floating)]
+
+
+def check_grad(spec: OpSpec):
+    """f32 VJP vs central finite differences, and bf16 VJP vs f32 VJP."""
+    vals = make_inputs(spec, np.float32)
+    gargs = _grad_args(spec, vals)
+    if not gargs:
+        return
+    # fixed cotangent so the scalar loss probes the full jacobian row-space
+    out0 = np.asarray(_apply(spec, vals), np.float64)
+    ct = np.cos(np.arange(out0.size, dtype=np.float64)).reshape(out0.shape)
+
+    def loss_np(*vs):
+        return float((np.asarray(_apply(spec, vs), np.float64) * ct).sum())
+
+    def loss_jax(*gvs):
+        full = list(vals)
+        for i, g in zip(gargs, gvs):
+            full[i] = g
+        out = _apply(spec, full)
+        return (out.astype(jnp.float32) * jnp.asarray(ct, jnp.float32)).sum()
+
+    grads = jax.grad(loss_jax, argnums=tuple(range(len(gargs))))(
+        *[jnp.asarray(vals[i]) for i in gargs])
+    for gi, i in enumerate(gargs):
+        g = np.asarray(grads[gi], np.float64)
+        v = vals[i]
+        fd = np.zeros_like(np.asarray(v, np.float64))
+        flat = fd.reshape(-1)
+        vflat = v.reshape(-1)
+        for j in range(vflat.size):
+            orig = vflat[j]
+            vflat[j] = orig + spec.fd_eps
+            up = loss_np(*vals)
+            vflat[j] = orig - spec.fd_eps
+            dn = loss_np(*vals)
+            vflat[j] = orig
+            flat[j] = (up - dn) / (2 * spec.fd_eps)
+        np.testing.assert_allclose(
+            g, fd, rtol=spec.fd_rtol, atol=spec.fd_atol,
+            err_msg=f"{spec.name}: analytic grad (arg {i}) != finite diff")
+
+    # bf16 grads: computed, finite, and near the f32 grads
+    bvals = [jnp.asarray(v).astype(jnp.bfloat16)
+             if np.issubdtype(np.asarray(v).dtype, np.floating) else jnp.asarray(v)
+             for v in vals]
+
+    def loss_bf16(*gvs):
+        full = list(bvals)
+        for i, g in zip(gargs, gvs):
+            full[i] = g
+        out = _apply(spec, full)
+        return (out.astype(jnp.float32) * jnp.asarray(ct, jnp.float32)).sum()
+
+    bgrads = jax.grad(loss_bf16, argnums=tuple(range(len(gargs))))(
+        *[bvals[i] for i in gargs])
+    for gi, i in enumerate(gargs):
+        bg = np.asarray(bgrads[gi].astype(jnp.float32), np.float64)
+        fg = np.asarray(grads[gi], np.float64)
+        assert np.isfinite(bg).all(), f"{spec.name}: non-finite bf16 grad"
+        scale = max(np.abs(fg).max(), 1.0)
+        np.testing.assert_allclose(
+            bg / scale, fg / scale, rtol=spec.bf16_rtol, atol=spec.bf16_atol,
+            err_msg=f"{spec.name}: bf16 grad drifted from f32 grad")
+
+
+def run_all_checks(spec: OpSpec):
+    check_forward(spec, np.float32)
+    if spec.check_bf16:
+        check_forward(spec, "bfloat16")
+    if spec.check_jit:
+        check_forward_jit(spec)
+    if spec.check_grad:
+        check_grad(spec)

@@ -1,0 +1,227 @@
+"""Distribution-zoo tail + transform family tests — scipy.stats parity for
+densities/statistics, autodiff-Jacobian parity for transform log-dets
+(reference: test/distribution/test_distribution_beta.py etc.)."""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _v(t):
+    return np.asarray(t.numpy(), np.float64)
+
+
+class TestZooDensities:
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        x = np.asarray([0.2, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(_v(d.log_prob(paddle.to_tensor(x))),
+                                   st.beta.logpdf(x, 2, 3), rtol=1e-5)
+        np.testing.assert_allclose(float(_v(d.mean)), 2 / 5, rtol=1e-6)
+        np.testing.assert_allclose(float(_v(d.entropy())),
+                                   st.beta.entropy(2, 3), rtol=1e-5)
+
+    def test_gamma(self):
+        d = D.Gamma(3.0, 2.0)
+        x = np.asarray([0.5, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(_v(d.log_prob(paddle.to_tensor(x))),
+                                   st.gamma.logpdf(x, 3, scale=0.5),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(_v(d.entropy())),
+                                   st.gamma.entropy(3, scale=0.5), rtol=1e-5)
+        np.testing.assert_allclose(_v(d.cdf(paddle.to_tensor(x))),
+                                   st.gamma.cdf(x, 3, scale=0.5), rtol=1e-5)
+
+    def test_dirichlet(self):
+        c = np.asarray([2.0, 3.0, 4.0], np.float32)
+        d = D.Dirichlet(c)
+        x = np.asarray([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(float(_v(d.log_prob(paddle.to_tensor(x)))),
+                                   st.dirichlet.logpdf(x, c), rtol=1e-5)
+        np.testing.assert_allclose(_v(d.mean), c / c.sum(), rtol=1e-6)
+        np.testing.assert_allclose(float(_v(d.entropy())),
+                                   st.dirichlet.entropy(c), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_laplace(self):
+        d = D.Laplace(1.0, 2.0)
+        x = np.asarray([-1.0, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(_v(d.log_prob(paddle.to_tensor(x))),
+                                   st.laplace.logpdf(x, 1, 2), rtol=1e-5)
+        np.testing.assert_allclose(_v(d.cdf(paddle.to_tensor(x))),
+                                   st.laplace.cdf(x, 1, 2), rtol=1e-5)
+        p = np.asarray([0.1, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(_v(d.icdf(paddle.to_tensor(p))),
+                                   st.laplace.ppf(p, 1, 2), rtol=1e-5)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.5, 0.8)
+        x = np.asarray([0.5, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            _v(d.log_prob(paddle.to_tensor(x))),
+            st.lognorm.logpdf(x, 0.8, scale=np.exp(0.5)), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(_v(d.mean)), st.lognorm.mean(0.8, scale=np.exp(0.5)),
+            rtol=1e-5)
+
+    def test_multinomial(self):
+        d = D.Multinomial(10, np.asarray([0.2, 0.3, 0.5], np.float32))
+        x = np.asarray([2.0, 3.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            float(_v(d.log_prob(paddle.to_tensor(x)))),
+            st.multinomial.logpmf([2, 3, 5], 10, [0.2, 0.3, 0.5]), rtol=1e-5)
+        np.testing.assert_allclose(_v(d.mean), [2.0, 3.0, 5.0], rtol=1e-5)
+        s = _v(d.sample((7,)))
+        assert s.shape == (7, 3)
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+
+    def test_geometric_gumbel_cauchy(self):
+        g = D.Geometric(0.3)
+        k = np.asarray([0.0, 2.0, 5.0], np.float32)
+        np.testing.assert_allclose(_v(g.log_prob(paddle.to_tensor(k))),
+                                   st.geom.logpmf(k + 1, 0.3), rtol=1e-5)
+        gm = D.Gumbel(1.0, 2.0)
+        x = np.asarray([-1.0, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(_v(gm.log_prob(paddle.to_tensor(x))),
+                                   st.gumbel_r.logpdf(x, 1, 2), rtol=1e-5)
+        c = D.Cauchy(0.5, 1.5)
+        np.testing.assert_allclose(_v(c.log_prob(paddle.to_tensor(x))),
+                                   st.cauchy.logpdf(x, 0.5, 1.5), rtol=1e-5)
+        np.testing.assert_allclose(_v(c.cdf(paddle.to_tensor(x))),
+                                   st.cauchy.cdf(x, 0.5, 1.5), rtol=1e-5)
+
+    def test_poisson_studentt_binomial(self):
+        p = D.Poisson(3.0)
+        k = np.asarray([0.0, 2.0, 6.0], np.float32)
+        np.testing.assert_allclose(_v(p.log_prob(paddle.to_tensor(k))),
+                                   st.poisson.logpmf(k, 3.0), rtol=1e-5)
+        t = D.StudentT(5.0, 1.0, 2.0)
+        x = np.asarray([-1.0, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(_v(t.log_prob(paddle.to_tensor(x))),
+                                   st.t.logpdf(x, 5, 1, 2), rtol=1e-5)
+        b = D.Binomial(8, 0.4)
+        np.testing.assert_allclose(_v(b.log_prob(paddle.to_tensor(k))),
+                                   st.binom.logpmf(k, 8, 0.4), rtol=1e-5)
+
+    def test_sample_moments(self):
+        paddle.seed(7)
+        for d, mean, std in [
+            (D.Beta(2.0, 3.0), 0.4, np.sqrt(st.beta.var(2, 3))),
+            (D.Gamma(3.0, 2.0), 1.5, np.sqrt(st.gamma.var(3, scale=0.5))),
+            (D.Laplace(1.0, 2.0), 1.0, np.sqrt(8.0)),
+            (D.Gumbel(1.0, 2.0), st.gumbel_r.mean(1, 2),
+             st.gumbel_r.std(1, 2)),
+        ]:
+            s = _v(d.sample((20000,)))
+            np.testing.assert_allclose(s.mean(), mean, atol=4 * std / 140)
+
+    def test_rsample_gradients_flow(self):
+        """Reparameterized sampling: d(sample.mean)/d(param) is nonzero."""
+        paddle.seed(0)
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        d = D.Laplace(loc, 1.0)
+        s = d.rsample((64,))
+        s.mean().backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.0, rtol=1e-4)
+
+    def test_kl_new_pairs(self):
+        # KL(p||q) >= 0, == 0 for identical, and matches a Monte-Carlo
+        # estimate for Beta
+        p, q = D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)
+        kl = float(_v(D.kl_divergence(p, q)))
+        assert kl > 0
+        assert abs(float(_v(D.kl_divergence(p, p)))) < 1e-6
+        paddle.seed(1)
+        x = _v(p.sample((40000,)))
+        mc = (st.beta.logpdf(x, 2, 3) - st.beta.logpdf(x, 3, 2)).mean()
+        np.testing.assert_allclose(kl, mc, rtol=0.08)
+        for pair in [(D.Gamma(3.0, 2.0), D.Gamma(2.0, 1.0)),
+                     (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+                     (D.Dirichlet(np.asarray([2.0, 3.0], np.float32)),
+                      D.Dirichlet(np.asarray([1.0, 1.0], np.float32)))]:
+            assert float(np.max(_v(D.kl_divergence(*pair)))) > 0
+
+
+class TestTransforms:
+    BIJ = None  # populated below
+
+    @pytest.mark.parametrize("t,x", [
+        (lambda: D.AffineTransform(1.0, 2.0), np.asarray([0.3, -1.2])),
+        (lambda: D.ExpTransform(), np.asarray([0.3, -1.2])),
+        (lambda: D.PowerTransform(2.0), np.asarray([0.5, 1.7])),
+        (lambda: D.SigmoidTransform(), np.asarray([0.3, -1.2])),
+        (lambda: D.TanhTransform(), np.asarray([0.3, -1.2])),
+    ])
+    def test_bijection_roundtrip_and_logdet(self, t, x):
+        tr = t()
+        x = x.astype(np.float32)
+        y = tr.forward(paddle.to_tensor(x))
+        back = _v(tr.inverse(y))
+        np.testing.assert_allclose(back, x, rtol=1e-5, atol=1e-6)
+        # log|dy/dx| vs autodiff
+        ldj = _v(tr.forward_log_det_jacobian(paddle.to_tensor(x)))
+        grad = jax.vmap(jax.grad(lambda v: tr._forward(v)))(jnp.asarray(x))
+        np.testing.assert_allclose(ldj, np.log(np.abs(np.asarray(grad))),
+                                   rtol=1e-5, atol=1e-6)
+        ildj = _v(tr.inverse_log_det_jacobian(y))
+        np.testing.assert_allclose(ildj, -ldj, rtol=1e-5, atol=1e-6)
+
+    def test_chain(self):
+        tr = D.ChainTransform([D.AffineTransform(0.5, 2.0),
+                               D.ExpTransform()])
+        x = np.asarray([0.1, -0.4], np.float32)
+        y = _v(tr.forward(paddle.to_tensor(x)))
+        np.testing.assert_allclose(y, np.exp(0.5 + 2.0 * x), rtol=1e-5)
+        np.testing.assert_allclose(_v(tr.inverse(paddle.to_tensor(y))), x,
+                                   rtol=1e-5)
+        ldj = _v(tr.forward_log_det_jacobian(paddle.to_tensor(x)))
+        grad = jax.vmap(jax.grad(lambda v: tr._forward(v)))(jnp.asarray(x))
+        np.testing.assert_allclose(ldj, np.log(np.abs(np.asarray(grad))),
+                                   rtol=1e-5)
+
+    def test_stickbreaking(self):
+        tr = D.StickBreakingTransform()
+        x = np.asarray([0.3, -0.8, 1.1], np.float32)
+        y = _v(tr.forward(paddle.to_tensor(x)))
+        assert y.shape == (4,)
+        assert (y > 0).all()
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(_v(tr.inverse(paddle.to_tensor(y))), x,
+                                   rtol=1e-4, atol=1e-5)
+        # log-det vs autodiff jacobian of the first k outputs
+        ldj = float(_v(tr.forward_log_det_jacobian(paddle.to_tensor(x))))
+        J = jax.jacobian(lambda v: tr._forward(v)[:-1])(jnp.asarray(x))
+        _, ref = np.linalg.slogdet(np.asarray(J, np.float64))
+        np.testing.assert_allclose(ldj, ref, rtol=1e-4)
+
+    def test_shapes_and_stack_reshape(self):
+        tr = D.StickBreakingTransform()
+        assert tr.forward_shape((5, 3)) == (5, 4)
+        assert tr.inverse_shape((5, 4)) == (5, 3)
+        rt = D.ReshapeTransform((6,), (2, 3))
+        y = rt.forward(paddle.to_tensor(np.arange(6, dtype=np.float32)))
+        assert tuple(y.shape) == (2, 3)
+        stk = D.StackTransform([D.ExpTransform(), D.AffineTransform(0.0, 2.0)])
+        x = np.asarray([[0.5, 1.0], [1.5, 2.0]], np.float32)
+        y = _v(stk.forward(paddle.to_tensor(x)))
+        np.testing.assert_allclose(y[0], np.exp(x[0]), rtol=1e-5)
+        np.testing.assert_allclose(y[1], 2 * x[1], rtol=1e-5)
+
+    def test_transformed_distribution_lognormal_parity(self):
+        """TransformedDistribution(Normal, ExpTransform) == LogNormal."""
+        td = D.TransformedDistribution(D.Normal(0.5, 0.8), D.ExpTransform())
+        ln = D.LogNormal(0.5, 0.8)
+        x = paddle.to_tensor(np.asarray([0.5, 1.0, 3.0], np.float32))
+        np.testing.assert_allclose(_v(td.log_prob(x)), _v(ln.log_prob(x)),
+                                   rtol=1e-5)
+        paddle.seed(3)
+        s = _v(td.sample((5000,)))
+        assert s.shape == (5000,)
+        assert (s > 0).all()

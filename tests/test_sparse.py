@@ -90,3 +90,108 @@ def test_transpose():
     t = sparse.transpose(_coo(), [1, 0])
     np.testing.assert_allclose(t.to_dense().numpy(),
                                _coo().to_dense().numpy().T)
+
+
+# --------------------------------------------------------------------------- #
+# CSR format (reference: sparse_csr_tensor, phi/kernels/sparse/ mv/matmul)
+# --------------------------------------------------------------------------- #
+
+
+def _csr():
+    return sparse.sparse_csr_tensor(
+        crows=[0, 2, 3, 5], cols=[0, 2, 1, 0, 2],
+        values=[1.0, 2.0, 3.0, 4.0, 5.0], shape=[3, 3])
+
+
+def test_csr_is_real_csr():
+    s = _csr()
+    assert s.is_sparse_csr() and not s.is_sparse_coo()
+    assert s.nnz == 5
+    np.testing.assert_array_equal(s.crows().numpy(), [0, 2, 3, 5])
+    np.testing.assert_array_equal(s.cols().numpy(), [0, 2, 1, 0, 2])
+    np.testing.assert_allclose(s.values().numpy(), [1, 2, 3, 4, 5])
+    ref = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 5]], np.float32)
+    np.testing.assert_allclose(s.to_dense().numpy(), ref)
+
+
+def test_csr_coo_roundtrip():
+    s = _csr()
+    coo = s.to_sparse_coo()
+    assert coo.is_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), s.to_dense().numpy())
+    back = coo.to_sparse_csr()
+    np.testing.assert_array_equal(back.crows().numpy(), s.crows().numpy())
+    np.testing.assert_array_equal(back.cols().numpy(), s.cols().numpy())
+    np.testing.assert_allclose(back.values().numpy(), s.values().numpy())
+
+
+def test_dense_to_sparse_methods():
+    ref = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 5]], np.float32)
+    t = paddle.to_tensor(ref)
+    coo = t.to_sparse_coo()
+    csr = t.to_sparse_csr()
+    np.testing.assert_allclose(coo.to_dense().numpy(), ref)
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 3, 5])
+    np.testing.assert_allclose(csr.to_dense().numpy(), ref)
+
+
+def test_csr_spmm_spmv():
+    s = _csr()
+    dense = s.to_dense().numpy()
+    d = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(
+        sparse.matmul(s, paddle.to_tensor(d)).numpy(), dense @ d, rtol=1e-6)
+    v = np.asarray([1.0, -2.0, 0.5], np.float32)
+    np.testing.assert_allclose(
+        sparse.mv(s, paddle.to_tensor(v)).numpy(), dense @ v, rtol=1e-6)
+    # COO spmv too
+    np.testing.assert_allclose(
+        sparse.mv(_coo(), paddle.to_tensor(v)).numpy(),
+        _coo().to_dense().numpy() @ v, rtol=1e-6)
+
+
+def test_csr_spmm_gradients():
+    import jax
+    import jax.numpy as jnp
+
+    s = _csr()
+    dense = s.to_dense().numpy()
+    d = np.arange(12, dtype=np.float32).reshape(3, 4)
+
+    def loss(vals):
+        s2 = sparse.SparseCsrTensor(s.crows(), s.cols(),
+                                    paddle.to_tensor(vals), s.shape)
+        return sparse.matmul(s2, paddle.to_tensor(d))._value.sum()
+
+    g = jax.grad(lambda v: loss(v))(jnp.asarray(s.values().numpy()))
+    # d(sum)/d(val_e) = sum of dense row d[cols[e]]
+    expect = d[[0, 2, 1, 0, 2]].sum(axis=1)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)
+
+
+def test_csr_binary_and_relu():
+    s = _csr()
+    two = (s + s).to_dense().numpy()
+    np.testing.assert_allclose(two, 2 * s.to_dense().numpy())
+    neg = sparse.sparse_csr_tensor([0, 1, 1, 1], [1], [-7.0], [3, 3])
+    r = sparse.nn.functional.relu(neg)
+    assert r.is_sparse_csr()
+    np.testing.assert_allclose(r.to_dense().numpy(), 0.0)
+    t = sparse.transpose(s, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(),
+                               s.to_dense().numpy().T)
+
+
+def test_spmv_spmm_eager_autograd():
+    """mv/matmul go through the tape: backward() reaches the dense operand."""
+    s = _csr()
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    sparse.mv(s, x).sum().backward()
+    # d(sum(Ax))/dx = column sums of A
+    np.testing.assert_allclose(x.grad.numpy(),
+                               _csr().to_dense().numpy().sum(0), rtol=1e-6)
+    W = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+    sparse.matmul(s, W).sum().backward()
+    np.testing.assert_allclose(
+        W.grad.numpy(), np.tile(s.to_dense().numpy().sum(0)[:, None], (1, 2)),
+        rtol=1e-6)
