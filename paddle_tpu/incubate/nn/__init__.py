@@ -1,3 +1,12 @@
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedMultiTransformer", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedBiasDropoutResidualLayerNorm"]

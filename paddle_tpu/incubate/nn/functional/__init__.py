@@ -723,3 +723,14 @@ def variable_length_memory_efficient_attention(
 __all__ += ["masked_multihead_attention", "blha_get_max_len",
             "block_multihead_attention",
             "variable_length_memory_efficient_attention"]
+
+
+from .fused_attention_ops import (  # noqa: E402,F401
+    fused_attention,
+    fused_bias_dropout_residual_layer_norm,
+    fused_feedforward,
+    fused_multi_head_attention,
+)
+
+__all__ += ["fused_attention", "fused_multi_head_attention",
+            "fused_feedforward", "fused_bias_dropout_residual_layer_norm"]

@@ -1,0 +1,287 @@
+"""fused_multi_head_attention / fused_feedforward /
+fused_bias_dropout_residual_layer_norm (reference:
+python/paddle/incubate/nn/functional/fused_transformer.py:513,47,334;
+kernels paddle/phi/kernels/fusion/gpu/fused_attention_kernel.cu,
+fused_feedforward_kernel.cu).
+
+TPU formulation: each op is ONE run_op composition — LN + projections +
+attention + residual epilogues trace into a single XLA program which fuses
+the epilogues into the matmuls (what the reference's hand-written mega
+kernels do by construction). The attention core routes to the Pallas flash
+kernel when it is maskless/dropoutless causal-free self-attention;
+otherwise the f32-softmax composite runs (still fused around the dots)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework import random as rnd
+from ....framework.core import Tensor, run_op, to_tensor
+from ....nn.functional._attn_math import NEG_INF
+from ....nn.functional._attn_math import masked_attention as _masked_attn
+
+__all__ = [
+    "fused_multi_head_attention",
+    "fused_attention",
+    "fused_feedforward",
+    "fused_bias_dropout_residual_layer_norm",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _ln(v, scale, bias, eps):
+    # stats in f32 (repo LN convention, nn/functional/norm.py — matches the
+    # reference fused kernels' float accumulators)
+    v32 = v.astype(jnp.float32)
+    mu = v32.mean(-1, keepdims=True)
+    var = ((v32 - mu) ** 2).mean(-1, keepdims=True)
+    out = (v32 - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(v.dtype)
+
+
+def _dropout(v, rate, key, training, mode):
+    if rate == 0.0 or key is None:
+        if mode == "downscale_in_infer" and not training:
+            return v * (1.0 - rate)
+        return v
+    if not training:
+        return v if mode == "upscale_in_train" else v * (1.0 - rate)
+    keep = jax.random.bernoulli(key, 1.0 - rate, v.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, v / (1.0 - rate), 0.0)
+    return jnp.where(keep, v, 0.0)
+
+
+def _maybe_keys(training, *rates):
+    return [rnd.next_key() if (training and r > 0.0) else None
+            for r in rates]
+
+
+def _use_flash():
+    from ....nn.functional.flash_attention import _use_pallas_kernel
+
+    return _use_pallas_kernel()
+
+
+def fused_multi_head_attention(
+    x,
+    qkv_weight,
+    linear_weight,
+    pre_layer_norm=False,
+    pre_ln_scale=None,
+    pre_ln_bias=None,
+    ln_scale=None,
+    ln_bias=None,
+    pre_ln_epsilon=1e-05,
+    qkv_bias=None,
+    linear_bias=None,
+    cache_kv=None,
+    attn_mask=None,
+    dropout_rate=0.5,
+    attn_dropout_rate=0.5,
+    ln_epsilon=1e-05,
+    training=True,
+    mode="upscale_in_train",
+    ring_id=-1,
+    add_residual=True,
+    num_heads=-1,
+    transpose_qkv_wb=False,
+    name=None,
+):
+    """reference: fused_transformer.py:513 — self-attention block with
+    (pre|post) LN, qkv projection, scaled-dot-product attention with mask +
+    attention dropout, output projection, residual + dropout."""
+    opt = {
+        "pre_ln_scale": pre_ln_scale, "pre_ln_bias": pre_ln_bias,
+        "ln_scale": ln_scale, "ln_bias": ln_bias, "qkv_bias": qkv_bias,
+        "linear_bias": linear_bias, "cache_kv": cache_kv,
+        "attn_mask": attn_mask,
+    }
+    names = [k for k, v in opt.items() if v is not None]
+    ins = [_t(x), _t(qkv_weight), _t(linear_weight)] + [
+        _t(opt[k]) for k in names]
+    akey, dkey = _maybe_keys(training, attn_dropout_rate, dropout_rate)
+
+    if transpose_qkv_wb and num_heads <= 0:
+        raise ValueError(
+            "transpose_qkv_wb=True requires num_heads > 0 (the [E, 3E] "
+            "weight layout does not carry the head count)")
+
+    def fn(xv, qkv_w, lin_w, *rest):
+        o = dict(zip(names, rest))
+        B, S, E = xv.shape
+        residual = xv
+        h = _ln(xv, o.get("pre_ln_scale"), o.get("pre_ln_bias"),
+                pre_ln_epsilon) if pre_layer_norm else xv
+        # q/k/v in paddle layout [B, S, H, D]
+        if transpose_qkv_wb:
+            H = num_heads
+            qkv = h @ qkv_w  # [B, S, 3E]
+            if "qkv_bias" in o:
+                qkv = qkv + o["qkv_bias"]
+            qkv = qkv.reshape(B, S, 3, H, E // H)
+        else:
+            # [B,S,E] x [3,H,D,E] -> [B,S,3,H,D]
+            qkv = jnp.einsum("bse,jhde->bsjhd", h, qkv_w)
+            if "qkv_bias" in o:
+                qkv = qkv + o["qkv_bias"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        D = q.shape[-1]
+        new_cache = None
+        if "cache_kv" in o:
+            # reference cache layout [2, B, H, S_cache, D]
+            ck = jnp.moveaxis(o["cache_kv"][0], 1, 2)  # -> [B, S, H, D]
+            cv = jnp.moveaxis(o["cache_kv"][1], 1, 2)
+            k = jnp.concatenate([ck.astype(k.dtype), k], axis=1)
+            v = jnp.concatenate([cv.astype(v.dtype), v], axis=1)
+            new_cache = jnp.stack([jnp.moveaxis(k, 1, 2),
+                                   jnp.moveaxis(v, 1, 2)])
+        keep = add_mask = None
+        if "attn_mask" in o:
+            m = o["attn_mask"]
+            if m.dtype == jnp.bool_:
+                keep = m
+            elif jnp.issubdtype(m.dtype, jnp.integer):
+                keep = m != 0
+            else:
+                add_mask = m
+        drop_active = akey is not None
+        if not drop_active and keep is None and add_mask is None \
+                and _use_flash():
+            from ....ops.pallas.flash_attention import flash_attention_fwd
+
+            ctx = flash_attention_fwd(q, k, v, causal=False)
+        elif not drop_active:
+            # shared f32 softmax/mask policy (nn/functional/_attn_math.py)
+            ctx = _masked_attn(q, k, v, keep=keep, add_mask=add_mask)
+        else:
+            s = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * (D ** -0.5)
+            if keep is not None:
+                s = jnp.where(keep, s, NEG_INF)
+            if add_mask is not None:
+                s = s + add_mask.astype(jnp.float32)
+            p = jax.nn.softmax(s, axis=-1)
+            p = _dropout(p, attn_dropout_rate, akey, training, mode)
+            ctx = jnp.einsum("bhst,bthd->bshd", p,
+                             v.astype(jnp.float32)).astype(xv.dtype)
+        ctx = ctx.reshape(B, S, -1)
+        out = ctx @ lin_w
+        if "linear_bias" in o:
+            out = out + o["linear_bias"]
+        out = _dropout(out, dropout_rate, dkey, training, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, o.get("ln_scale"), o.get("ln_bias"), ln_epsilon)
+        out = out.astype(xv.dtype)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+    out = run_op("fused_multi_head_attention", fn, ins,
+                 n_outputs=2 if cache_kv is not None else None)
+    return out
+
+
+fused_attention = fused_multi_head_attention
+
+
+def fused_feedforward(
+    x,
+    linear1_weight,
+    linear2_weight,
+    linear1_bias=None,
+    linear2_bias=None,
+    ln1_scale=None,
+    ln1_bias=None,
+    ln2_scale=None,
+    ln2_bias=None,
+    dropout1_rate=0.5,
+    dropout2_rate=0.5,
+    activation="relu",
+    ln1_epsilon=1e-5,
+    ln2_epsilon=1e-5,
+    pre_layer_norm=False,
+    training=True,
+    mode="upscale_in_train",
+    ring_id=-1,
+    add_residual=True,
+    name=None,
+):
+    """reference: fused_transformer.py:47 —
+    out = linear2(dropout1(act(linear1(maybe_ln1(x))))); residual + dropout2;
+    post-LN when not pre_layer_norm."""
+    opt = {
+        "linear1_bias": linear1_bias, "linear2_bias": linear2_bias,
+        "ln1_scale": ln1_scale, "ln1_bias": ln1_bias,
+        "ln2_scale": ln2_scale, "ln2_bias": ln2_bias,
+    }
+    names = [k for k, v in opt.items() if v is not None]
+    ins = [_t(x), _t(linear1_weight), _t(linear2_weight)] + [
+        _t(opt[k]) for k in names]
+    k1, k2 = _maybe_keys(training, dropout1_rate, dropout2_rate)
+    acts = {
+        "relu": jax.nn.relu,
+        "gelu": lambda v: jax.nn.gelu(v, approximate=False),  # paddle exact
+        "silu": jax.nn.silu, "swish": jax.nn.silu, "tanh": jnp.tanh,
+    }
+    act = acts[activation]
+
+    def fn(xv, w1, w2, *rest):
+        o = dict(zip(names, rest))
+        residual = xv
+        h = _ln(xv, o.get("ln1_scale"), o.get("ln1_bias"),
+                ln1_epsilon) if pre_layer_norm else xv
+        h = h @ w1
+        if "linear1_bias" in o:
+            h = h + o["linear1_bias"]
+        h = _dropout(act(h), dropout1_rate, k1, training, mode)
+        h = h @ w2
+        if "linear2_bias" in o:
+            h = h + o["linear2_bias"]
+        h = _dropout(h, dropout2_rate, k2, training, mode)
+        if add_residual:
+            h = residual + h
+        if not pre_layer_norm:
+            h = _ln(h, o.get("ln2_scale"), o.get("ln2_bias"), ln2_epsilon)
+        return h.astype(xv.dtype)
+
+    return run_op("fused_feedforward", fn, ins)
+
+
+def fused_bias_dropout_residual_layer_norm(
+    x,
+    residual,
+    bias=None,
+    ln_scale=None,
+    ln_bias=None,
+    dropout_rate=0.5,
+    ln_epsilon=1e-5,
+    training=True,
+    mode="upscale_in_train",
+    name=None,
+):
+    """reference: fused_transformer.py:334 —
+    layer_norm(residual + dropout(x + bias))."""
+    opt = {"bias": bias, "ln_scale": ln_scale, "ln_bias": ln_bias}
+    names = [k for k, v in opt.items() if v is not None]
+    ins = [_t(x), _t(residual)] + [_t(opt[k]) for k in names]
+    (key,) = _maybe_keys(training, dropout_rate)
+
+    def fn(xv, res, *rest):
+        o = dict(zip(names, rest))
+        h = xv + o["bias"] if "bias" in o else xv
+        h = res + _dropout(h, dropout_rate, key, training, mode)
+        return _ln(h, o.get("ln_scale"), o.get("ln_bias"),
+                   ln_epsilon).astype(xv.dtype)
+
+    return run_op("fused_bias_dropout_residual_layer_norm", fn, ins)

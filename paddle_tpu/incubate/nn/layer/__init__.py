@@ -1,3 +1,11 @@
 from .fused_transformer import FusedMultiTransformer  # noqa: F401
+from .fused_attention_layers import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedTransformerEncoderLayer,
+)
 
-__all__ = ["FusedMultiTransformer"]
+__all__ = ["FusedMultiTransformer", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedBiasDropoutResidualLayerNorm"]

@@ -1,0 +1,264 @@
+"""FusedMultiHeadAttention / FusedFeedForward / FusedTransformerEncoderLayer /
+FusedBiasDropoutResidualLayerNorm (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py:103 (BDRLN), :378
+(FusedMultiHeadAttention), :703 (FusedFeedForward), :870
+(FusedTransformerEncoderLayer)).
+
+Thin parameter-holders over the fused functional ops — the fusion itself
+lives in functional/fused_attention_ops.py as single-XLA-program
+compositions. TP: qkv/linear weights carry column/row dist_attr specs the
+way the reference calls _set_var_distributed when nranks > 1."""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.nn as nn
+from ....framework.core import Tensor
+from ....nn import initializer as _I
+
+_ONES = _I.Constant(1.0)
+from ..functional.fused_attention_ops import (
+    fused_bias_dropout_residual_layer_norm,
+    fused_feedforward,
+    fused_multi_head_attention,
+)
+
+__all__ = [
+    "FusedMultiHeadAttention",
+    "FusedFeedForward",
+    "FusedTransformerEncoderLayer",
+    "FusedBiasDropoutResidualLayerNorm",
+]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """reference: fused_transformer.py:378."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        assert not need_weights, "need_weights=True is not supported"
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.transpose_qkv_wb = transpose_qkv_wb
+        self._epsilon = epsilon
+        self.name = name
+        if transpose_qkv_wb:
+            qkv_w_shape = [embed_dim, 3 * embed_dim]
+            qkv_b_shape = [3 * embed_dim]
+        else:
+            qkv_w_shape = [3, num_heads, self.head_dim, embed_dim]
+            qkv_b_shape = [3, num_heads, self.head_dim]
+        self.qkv_weight = self.create_parameter(qkv_w_shape,
+                                                attr=qkv_weight_attr)
+        self.qkv_bias = (None if qkv_bias_attr is False else
+                         self.create_parameter(qkv_b_shape,
+                                               attr=qkv_bias_attr,
+                                               is_bias=True))
+        self.linear_weight = self.create_parameter(
+            [num_heads * self.head_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = (None if linear_bias_attr is False else
+                            self.create_parameter([embed_dim],
+                                                  attr=linear_bias_attr,
+                                                  is_bias=True))
+        # TP layout (reference _set_var_distributed): qkv column-parallel
+        # over heads, out-proj row-parallel
+        if not transpose_qkv_wb:
+            self.qkv_weight.dist_attr = P(None, "mp", None, None)
+            if self.qkv_bias is not None:
+                self.qkv_bias.dist_attr = P(None, "mp", None)
+        self.linear_weight.dist_attr = P("mp", None)
+        self.linear_weight.is_distributed = True
+        if not transpose_qkv_wb:  # [E, 3E] layout stays replicated
+            self.qkv_weight.is_distributed = True
+            if self.qkv_bias is not None:
+                self.qkv_bias.is_distributed = True
+        if normalize_before:
+            self.pre_ln_scale = self.create_parameter(
+                [embed_dim], attr=pre_ln_scale_attr, default_initializer=_ONES)
+            self.pre_ln_bias = self.create_parameter(
+                [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+            self.ln_scale = None
+            self.ln_bias = None
+        else:
+            self.pre_ln_scale = None
+            self.pre_ln_bias = None
+            self.ln_scale = self.create_parameter(
+                [embed_dim], attr=ln_scale_attr, default_initializer=_ONES)
+            self.ln_bias = self.create_parameter(
+                [embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+            num_heads=self.num_heads,
+            transpose_qkv_wb=self.transpose_qkv_wb, name=self.name)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, num_heads={self.num_heads}, "
+                f"dropout_rate={self.dropout_rate}, "
+                f"attn_dropout_rate={self.attn_dropout_rate}, "
+                f"epsilon={self._epsilon}")
+
+
+class FusedFeedForward(nn.Layer):
+    """reference: fused_transformer.py:703."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._d_model = d_model
+        self._dim_feedforward = dim_feedforward
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                  else act_dropout_rate)
+        self._act_method = activation
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.name = name
+        self._linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self._linear1_bias = (None if linear1_bias_attr is False else
+                              self.create_parameter([dim_feedforward],
+                                                    attr=linear1_bias_attr,
+                                                    is_bias=True))
+        self._linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self._linear2_bias = (None if linear2_bias_attr is False else
+                              self.create_parameter([d_model],
+                                                    attr=linear2_bias_attr,
+                                                    is_bias=True))
+        self._linear1_weight.dist_attr = P(None, "mp")
+        self._linear2_weight.dist_attr = P("mp", None)
+        self._linear1_weight.is_distributed = True
+        self._linear2_weight.is_distributed = True
+        if self._linear1_bias is not None:
+            self._linear1_bias.dist_attr = P("mp")
+            self._linear1_bias.is_distributed = True
+        if normalize_before:
+            self._ln1_scale = self.create_parameter(
+                [d_model], attr=ln1_scale_attr, default_initializer=_ONES)
+            self._ln1_bias = self.create_parameter(
+                [d_model], attr=ln1_bias_attr, is_bias=True)
+            self._ln2_scale = None
+            self._ln2_bias = None
+        else:
+            self._ln1_scale = None
+            self._ln1_bias = None
+            self._ln2_scale = self.create_parameter(
+                [d_model], attr=ln2_scale_attr, default_initializer=_ONES)
+            self._ln2_bias = self.create_parameter(
+                [d_model], attr=ln2_bias_attr, is_bias=True)
+
+    def forward(self, src, cache=None):
+        return fused_feedforward(
+            src, self._linear1_weight, self._linear2_weight,
+            linear1_bias=self._linear1_bias, linear2_bias=self._linear2_bias,
+            ln1_scale=self._ln1_scale, ln1_bias=self._ln1_bias,
+            ln2_scale=self._ln2_scale, ln2_bias=self._ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._act_method, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training,
+            name=self.name)
+
+    def extra_repr(self):
+        return (f"d_model={self._d_model}, "
+                f"dim_feedforward={self._dim_feedforward}, "
+                f"dropout_rate={self._dropout_rate}, "
+                f"epsilon={self._epsilon}, "
+                f"activation={self._act_method}, "
+                f"normalize_before={self._normalize_before}")
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """reference: fused_transformer.py:870 — FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.normalize_before = normalize_before
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                             cache=cache)
+            return self.ffn(out), new_cache
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """reference: fused_transformer.py:103."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-05, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.name = name
+        self.linear_bias = (None if bias_attr is False else
+                            self.create_parameter([embed_dim],
+                                                  attr=bias_attr,
+                                                  is_bias=True))
+        self.ln_scale = self.create_parameter([embed_dim], attr=weight_attr,
+                                              default_initializer=_ONES)
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+            name=self.name)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, "
+                f"dropout_rate={self.dropout_rate}, "
+                f"epsilon={self._epsilon}")

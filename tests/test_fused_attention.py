@@ -1,0 +1,204 @@
+"""fused_multi_head_attention / fused_feedforward + layers: parity vs
+composed nn ops (reference test:
+test/legacy_test/test_fused_attention_op_api.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.incubate.nn.functional as IF
+from paddle_tpu.incubate.nn import (FusedBiasDropoutResidualLayerNorm,
+                                    FusedFeedForward, FusedMultiHeadAttention,
+                                    FusedTransformerEncoderLayer)
+
+B, S, E, H = 2, 8, 32, 4
+D = E // H
+
+
+def _ln_np(x, scale, bias, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    out = (x - mu) / np.sqrt(var + eps)
+    return out * scale + bias
+
+
+def _ref_attention_block(x, qkv_w, qkv_b, lin_w, lin_b, pre_ln, ln_s, ln_b,
+                         mask=None):
+    h = _ln_np(x, ln_s, ln_b) if pre_ln else x
+    qkv = np.einsum("bse,jhde->bsjhd", h, qkv_w) + qkv_b
+    q, k, v = (np.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+    s = np.einsum("bhsd,bhtd->bhst", q / np.sqrt(D), k)
+    if mask is not None:
+        s = s + mask
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ctx = np.einsum("bhst,bhtd->bhsd", p, v)
+    ctx = np.moveaxis(ctx, 1, 2).reshape(B, S, E)
+    out = ctx @ lin_w + lin_b
+    out = x + out
+    if not pre_ln:
+        out = _ln_np(out, ln_s, ln_b)
+    return out
+
+
+@pytest.mark.parametrize("pre_ln", [False, True])
+def test_fused_mha_matches_reference_math(pre_ln):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, S, E)).astype(np.float32)
+    qkv_w = rng.normal(size=(3, H, D, E)).astype(np.float32) * 0.2
+    qkv_b = rng.normal(size=(3, H, D)).astype(np.float32) * 0.1
+    lin_w = rng.normal(size=(E, E)).astype(np.float32) * 0.2
+    lin_b = rng.normal(size=(E,)).astype(np.float32) * 0.1
+    ln_s = rng.normal(size=(E,)).astype(np.float32) * 0.1 + 1.0
+    ln_b = rng.normal(size=(E,)).astype(np.float32) * 0.1
+    mask = np.where(rng.random((B, 1, S, S)) > 0.2, 0.0, -1e9).astype(
+        np.float32)
+
+    out = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        pre_layer_norm=pre_ln,
+        pre_ln_scale=paddle.to_tensor(ln_s) if pre_ln else None,
+        pre_ln_bias=paddle.to_tensor(ln_b) if pre_ln else None,
+        ln_scale=None if pre_ln else paddle.to_tensor(ln_s),
+        ln_bias=None if pre_ln else paddle.to_tensor(ln_b),
+        qkv_bias=paddle.to_tensor(qkv_b), linear_bias=paddle.to_tensor(lin_b),
+        attn_mask=paddle.to_tensor(mask),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    ref = _ref_attention_block(x, qkv_w, qkv_b, lin_w, lin_b, pre_ln,
+                               ln_s, ln_b, mask)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_mha_bool_mask_and_cache():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(B, S, E)).astype(np.float32)
+    qkv_w = rng.normal(size=(3, H, D, E)).astype(np.float32) * 0.2
+    lin_w = rng.normal(size=(E, E)).astype(np.float32) * 0.2
+    bool_mask = rng.random((B, 1, S, S)) > 0.2
+    out_b = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        attn_mask=paddle.to_tensor(bool_mask),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    add_mask = np.where(bool_mask, 0.0, -1e30).astype(np.float32)
+    out_f = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        attn_mask=paddle.to_tensor(add_mask),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    np.testing.assert_allclose(out_b.numpy(), out_f.numpy(), rtol=1e-5)
+
+    # cache path: prefix cache + new tokens == full-sequence attention rows
+    cache = paddle.to_tensor(np.zeros((2, B, H, 0, D), np.float32))
+    out_c, new_cache = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        cache_kv=cache, dropout_rate=0.0, attn_dropout_rate=0.0)
+    assert tuple(new_cache.shape) == (2, B, H, S, D)
+    out_nc = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    np.testing.assert_allclose(out_c.numpy(), out_nc.numpy(), rtol=1e-5)
+
+
+def test_fused_feedforward_matches_composition():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(B, S, E)).astype(np.float32)
+    w1 = rng.normal(size=(E, 4 * E)).astype(np.float32) * 0.2
+    b1 = rng.normal(size=(4 * E,)).astype(np.float32) * 0.1
+    w2 = rng.normal(size=(4 * E, E)).astype(np.float32) * 0.2
+    b2 = rng.normal(size=(E,)).astype(np.float32) * 0.1
+    ln_s = np.ones(E, np.float32)
+    ln_b = np.zeros(E, np.float32)
+    out = IF.fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        linear1_bias=paddle.to_tensor(b1), linear2_bias=paddle.to_tensor(b2),
+        ln1_scale=paddle.to_tensor(ln_s), ln1_bias=paddle.to_tensor(ln_b),
+        dropout1_rate=0.0, dropout2_rate=0.0, activation="gelu",
+        pre_layer_norm=True)
+    h = _ln_np(x, ln_s, ln_b)
+    from scipy.special import erf
+
+    g = h @ w1 + b1
+    g = g * 0.5 * (1 + erf(g / np.sqrt(2)))
+    ref = x + (g @ w2 + b2)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_layers_train_and_dropout_behaves():
+    paddle.seed(0)
+    layer = FusedTransformerEncoderLayer(E, H, 4 * E, dropout_rate=0.0,
+                                         normalize_before=True)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=layer.parameters())
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    tgt = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    losses = []
+    for _ in range(10):
+        loss = ((layer(x) - tgt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+    # dropout active in train (stochastic), identity in eval
+    mha = FusedMultiHeadAttention(E, H, dropout_rate=0.5,
+                                  attn_dropout_rate=0.5)
+    y1 = mha(x).numpy()
+    y2 = mha(x).numpy()
+    assert not np.allclose(y1, y2)
+    mha.eval()
+    e1 = mha(x).numpy()
+    e2 = mha(x).numpy()
+    np.testing.assert_allclose(e1, e2)
+
+
+def test_fused_mha_layer_parity_with_functional():
+    paddle.seed(0)
+    mha = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                  attn_dropout_rate=0.0)
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    out = mha(x)
+    ref = IF.fused_multi_head_attention(
+        x, mha.qkv_weight, mha.linear_weight, qkv_bias=mha.qkv_bias,
+        linear_bias=mha.linear_bias, ln_scale=mha.ln_scale,
+        ln_bias=mha.ln_bias, dropout_rate=0.0, attn_dropout_rate=0.0)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+
+def test_transpose_qkv_wb_variant():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(B, S, E)).astype(np.float32)
+    qkv_w4 = rng.normal(size=(3, H, D, E)).astype(np.float32) * 0.2
+    lin_w = rng.normal(size=(E, E)).astype(np.float32) * 0.2
+    out4 = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w4),
+        paddle.to_tensor(lin_w), dropout_rate=0.0, attn_dropout_rate=0.0)
+    # same weights in [E, 3E] layout: w2[e, j*E + h*D + d] = w4[j, h, d, e]
+    qkv_w2 = np.moveaxis(qkv_w4.reshape(3, E, E), 1, 2).reshape(
+        3, E, E).transpose(1, 0, 2).reshape(E, 3 * E)
+    out2 = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w2),
+        paddle.to_tensor(lin_w), dropout_rate=0.0, attn_dropout_rate=0.0,
+        num_heads=H, transpose_qkv_wb=True)
+    np.testing.assert_allclose(out2.numpy(), out4.numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_bias_dropout_residual_ln():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(B, S, E)).astype(np.float32)
+    res = rng.normal(size=(B, S, E)).astype(np.float32)
+    b = rng.normal(size=(E,)).astype(np.float32)
+    out = IF.fused_bias_dropout_residual_layer_norm(
+        paddle.to_tensor(x), paddle.to_tensor(res), bias=paddle.to_tensor(b),
+        ln_scale=paddle.to_tensor(np.ones(E, np.float32)),
+        ln_bias=paddle.to_tensor(np.zeros(E, np.float32)), dropout_rate=0.0)
+    ref = _ln_np(res + x + b, np.ones(E), np.zeros(E))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+    paddle.seed(0)
+    layer = FusedBiasDropoutResidualLayerNorm(E, dropout_rate=0.0)
+    out_l = layer(paddle.to_tensor(x), paddle.to_tensor(res))
+    assert out_l.shape == [B, S, E]
