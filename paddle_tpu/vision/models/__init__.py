@@ -1,3 +1,14 @@
+from .alexnet import AlexNet, alexnet
+from .densenet import (
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    densenet264,
+)
+from .googlenet import GoogLeNet, googlenet
+from .inceptionv3 import InceptionV3, inception_v3
 from .lenet import LeNet
 from .mobilenet import (
     MobileNetV1,
@@ -21,6 +32,17 @@ from .resnet import (
     wide_resnet50_2,
     wide_resnet101_2,
 )
+from .shufflenetv2 import (
+    ShuffleNetV2,
+    shufflenet_v2_swish,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 
 __all__ = [
@@ -30,4 +52,13 @@ __all__ = [
     "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
     "MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
     "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small", "mobilenet_v3_large",
+    "AlexNet", "alexnet",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264",
+    "GoogLeNet", "googlenet",
+    "InceptionV3", "inception_v3",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "shufflenet_v2_swish",
 ]

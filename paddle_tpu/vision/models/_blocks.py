@@ -1,0 +1,22 @@
+"""Shared conv building blocks for the vision zoo."""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["ConvBNReLU"]
+
+
+class ConvBNReLU(nn.Layer):
+    """Conv2D (no bias) + BatchNorm2D + ReLU — the stem/branch unit shared
+    by GoogLeNet and InceptionV3."""
+
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))

@@ -1,0 +1,151 @@
+"""Inception v3 (reference API: python/paddle/vision/models/inceptionv3.py;
+architecture from Szegedy et al. 2015 — factorized convolutions, 299 input)."""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+from ._blocks import ConvBNReLU as _ConvBN
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _IncA(nn.Layer):
+    """1x1 + 5x5 + double-3x3 + pool-proj (35x35 grid)."""
+
+    def __init__(self, in_ch, pool_ch):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(in_ch, 48, 1),
+                                _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(in_ch, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(in_ch, pool_ch, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class _IncB(nn.Layer):
+    """grid reduction 35 -> 17."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _ConvBN(in_ch, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(in_ch, 64, 1),
+                                 _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):
+    """factorized 7x7 branches (17x17 grid)."""
+
+    def __init__(self, in_ch, mid):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(in_ch, mid, 1),
+            _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            _ConvBN(mid, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBN(in_ch, mid, 1),
+            _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            _ConvBN(mid, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(in_ch, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class _IncD(nn.Layer):
+    """grid reduction 17 -> 8."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(in_ch, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(in_ch, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(nn.Layer):
+    """expanded-filter-bank block (8x8 grid)."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, 320, 1)
+        self.b3_stem = _ConvBN(in_ch, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_ConvBN(in_ch, 448, 1),
+                                      _ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(in_ch, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return paddle.concat(
+            [self.b1(x),
+             paddle.concat([self.b3_a(s), self.b3_b(s)], axis=1),
+             paddle.concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+             self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return InceptionV3(**kwargs)

@@ -1,0 +1,143 @@
+"""ShuffleNetV2 (reference API: python/paddle/vision/models/shufflenetv2.py;
+architecture from Ma et al. 2018 — channel split + shuffle)."""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+def _act(name):
+    if name not in ("relu", "swish"):
+        raise ValueError(f"act must be 'relu' or 'swish', got {name!r}")
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+def _channel_shuffle(x, groups):
+    B, C, H, W = x.shape
+    x = x.reshape([B, groups, C // groups, H, W])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([B, C, H, W])
+
+
+class _ShuffleUnit(nn.Layer):
+    """stride-1 unit: split channels, transform one half, concat + shuffle."""
+
+    def __init__(self, ch, act):
+        super().__init__()
+        branch = ch // 2
+        self.branch = nn.Sequential(
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act),
+            nn.Conv2D(branch, branch, 3, padding=1, groups=branch,
+                      bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act),
+        )
+
+    def forward(self, x):
+        half = x.shape[1] // 2
+        x1, x2 = x[:, :half], x[:, half:]
+        out = paddle.concat([x1, self.branch(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class _ShuffleUnitDown(nn.Layer):
+    """stride-2 unit: both branches downsample, channels double."""
+
+    def __init__(self, in_ch, out_ch, act):
+        super().__init__()
+        branch = out_ch // 2
+        self.branch1 = nn.Sequential(
+            nn.Conv2D(in_ch, in_ch, 3, stride=2, padding=1, groups=in_ch,
+                      bias_attr=False),
+            nn.BatchNorm2D(in_ch),
+            nn.Conv2D(in_ch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act),
+        )
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_ch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act),
+            nn.Conv2D(branch, branch, 3, stride=2, padding=1, groups=branch,
+                      bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), _act(act),
+        )
+
+    def forward(self, x):
+        out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        assert scale in _STAGE_OUT, f"scale must be one of {sorted(_STAGE_OUT)}"
+        chs = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, chs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(chs[0]), _act(act),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        stages = []
+        in_ch = chs[0]
+        for stage_i, repeats in enumerate(_REPEATS):
+            out_ch = chs[stage_i + 1]
+            stages.append(_ShuffleUnitDown(in_ch, out_ch, act))
+            for _ in range(repeats - 1):
+                stages.append(_ShuffleUnit(out_ch, act))
+            in_ch = out_ch
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_ch, chs[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(chs[-1]), _act(act),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _make(scale, act="relu", tag=None):
+    def build(pretrained=False, **kwargs):
+        assert not pretrained, "pretrained weights are not bundled"
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+    build.__name__ = tag or f"shufflenet_v2_x{str(scale).replace('.', '_')}"
+    return build
+
+
+shufflenet_v2_x0_25 = _make(0.25)
+shufflenet_v2_x0_33 = _make(0.33)
+shufflenet_v2_x0_5 = _make(0.5)
+shufflenet_v2_x1_0 = _make(1.0)
+shufflenet_v2_x1_5 = _make(1.5)
+shufflenet_v2_x2_0 = _make(2.0)
+shufflenet_v2_swish = _make(1.0, act="swish", tag="shufflenet_v2_swish")

@@ -160,6 +160,65 @@ class TestVisionZoo:
         assert tuple(out.shape) == (2, 10)
         assert np.isfinite(out.numpy()).all()
 
+    @pytest.mark.parametrize("ctor,kw,hw", [
+        ("alexnet", {}, 224),
+        ("squeezenet1_1", {}, 64),
+        ("densenet121", {}, 64),
+        ("shufflenet_v2_x0_25", {}, 64),
+        ("shufflenet_v2_swish", {}, 64),
+        ("inception_v3", {}, 299),
+    ])
+    def test_new_zoo_forward_shapes(self, ctor, kw, hw):
+        from paddle_tpu.vision import models
+
+        paddle.seed(0)
+        m = getattr(models, ctor)(num_classes=10, **kw)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 3, hw, hw))
+            .astype(np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (2, 10)
+        assert np.isfinite(out.numpy()).all()
+
+    def test_googlenet_aux_heads(self):
+        from paddle_tpu.vision.models import googlenet
+
+        paddle.seed(0)
+        m = googlenet(num_classes=7)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 3, 64, 64))
+            .astype(np.float32))
+        out, aux1, aux2 = m(x)
+        for o in (out, aux1, aux2):
+            assert tuple(o.shape) == (2, 7)
+            assert np.isfinite(o.numpy()).all()
+
+    def test_new_zoo_train_step(self):
+        from paddle_tpu.vision.models import densenet121, shufflenet_v2_x0_25
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        for build in (densenet121, shufflenet_v2_x0_25):
+            paddle.seed(0)
+            m = build(num_classes=4)
+            m.train()
+            ce = nn.CrossEntropyLoss()
+            o = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+            x = paddle.to_tensor(
+                np.random.default_rng(1).standard_normal((4, 3, 32, 32))
+                .astype(np.float32))
+            y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+            losses = []
+            for _ in range(8):
+                loss = ce(m(x), y)
+                loss.backward()
+                o.step()
+                o.clear_grad()
+                losses.append(float(loss.numpy()))
+            assert losses[-1] < losses[0], build.__name__
+
     def test_mobilenet_trains(self):
         from paddle_tpu.vision.models import mobilenet_v2
         import paddle_tpu.nn as nn
