@@ -255,6 +255,9 @@ def register_tensor_method(name: str, fn: Callable):
     setattr(Tensor, name, fn)
 
 
+_tensor_ctr = 0
+
+
 class Tensor:
     """User-facing tensor handle: jax.Array value + autograd slot.
 
@@ -276,6 +279,13 @@ class Tensor:
         "placements",
         "dist_attr",
         "is_dist_tensor",
+        # creation ordinal: lets the SOT capture (jit/sot.py) detect tensors
+        # produced during a recording by paths that bypass run_op (nested
+        # jits) — those cannot be replayed and force an eager fallback
+        "_ctr",
+        # True when the value was materialized from host data (to_tensor on
+        # scalars/ndarrays) — a frame CONSTANT the SOT capture may bake
+        "_host_const",
         "__weakref__",
     )
 
@@ -283,6 +293,10 @@ class Tensor:
         if isinstance(value, Tensor):
             value = value._value
         self._value = value
+        global _tensor_ctr
+        _tensor_ctr += 1
+        self._ctr = _tensor_ctr
+        self._host_const = False
         self.stop_gradient = stop_gradient
         self.grad = None
         self._grad_node = None
@@ -352,12 +366,22 @@ class Tensor:
         return id(self)
 
     def __bool__(self):
+        if _sync_observer is not None:
+            _sync_observer("bool", self)
         return bool(self._value)
 
     def __int__(self):
+        if _sync_observer is not None:
+            _sync_observer("int", self)
         return int(self._value)
 
     def __float__(self):
+        # NOTE: float() coerces __float__'s return to exact float in
+        # CPython 3.12+, so the SOT capture's deferred-guard scalar cannot
+        # ride this path (it does ride .item()); observers get the exact
+        # value guard here
+        if _sync_observer is not None:
+            _sync_observer("float", self)
         return float(self._value)
 
     def __format__(self, spec):
@@ -368,15 +392,23 @@ class Tensor:
     # -- conversion -------------------------------------------------------- #
 
     def numpy(self):
+        if _sync_observer is not None:
+            _sync_observer("array", self)
         return np.asarray(self._value)
 
     def item(self, *args):
+        if _sync_observer is not None:
+            rep = _sync_observer("item" if not args else "array", self)
+            if rep is not None:
+                return rep
         if args:
-            return self.numpy().item(*args)
-        return self.numpy().item()
+            return np.asarray(self._value).item(*args)
+        return np.asarray(self._value).item()
 
     def tolist(self):
-        return self.numpy().tolist()
+        if _sync_observer is not None:
+            _sync_observer("array", self)
+        return np.asarray(self._value).tolist()
 
     def detach(self):
         t = Tensor(self._value, stop_gradient=True, name=self.name)
@@ -802,8 +834,12 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         # match paddle: numpy float64 keeps its dtype only when explicit;
         # default behavior converts to default dtype
         nd = data.dtype
+    host_src = not isinstance(data, jax.Array) and not (
+        isinstance(data, jax.core.Tracer))
     val = jnp.asarray(data, dtype=None if nd is None else jnp.dtype(nd))
-    return Tensor(val, stop_gradient=stop_gradient)
+    t = Tensor(val, stop_gradient=stop_gradient)
+    t._host_const = host_src
+    return t
 
 
 def _unwrap(x):
@@ -843,11 +879,20 @@ def set_op_check_hook(fn):
 # Program op-desc appending under program_guard (python/paddle/base/
 # framework.py append_op).
 _op_recorder: Callable | None = None
+# Called with (kind, tensor) when Python control flow consumes a concrete
+# tensor value (__bool__/__int__/__float__) — the graph-break points the SOT
+# capture (jit/sot.py) segments compiled subgraphs around.
+_sync_observer: Callable | None = None
 
 
 def set_op_recorder(fn):
     global _op_recorder
     _op_recorder = fn
+
+
+def set_sync_observer(fn):
+    global _sync_observer
+    _sync_observer = fn
 
 
 def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = None):

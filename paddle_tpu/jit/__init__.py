@@ -138,9 +138,11 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     (shape, dtype) signature triggers a retrace, matching the reference SOT
     guard semantics (python/paddle/jit/sot/translate.py:97-106). Frames the
     tracer cannot swallow (data-dependent Python control flow, concretized
-    shapes) permanently FALL BACK to eager execution — the reference SOT's
-    dygraph fallback for ineligible frames (translate.py BreakGraphError
-    path) rather than a user-facing crash.
+    shapes) fall back to SOT GRAPH-BREAK CAPTURE (jit/sot.py): the frame is
+    re-run once eagerly while recording, split at the concrete-value sync
+    points, and thereafter executes as compiled subgraphs around the breaks
+    — the reference SOT's partial-graph behavior (translate.py
+    BreakGraphError path) rather than losing all compilation.
     """
     if function is None:
         return lambda f: to_static(f, input_spec=input_spec)
@@ -164,23 +166,30 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
         return _unwrap_pytree(out)
 
     fell_back = [False]
+    sot = [None]
 
     @functools.wraps(fn)
     def wrapper(*args):
         if fell_back[0]:
-            return fn(*args)
+            return sot[0](*args)
         raw = _unwrap_pytree(list(args))
         try:
             out = traced(raw)
         except Exception as e:
             if not _is_trace_ineligible(e):
                 raise
+            # graph-break capture: compiled subgraphs around the dynamic
+            # control flow instead of a permanent whole-frame eager fallback
+            from .sot import SOTCapture
+
             fell_back[0] = True
-            return fn(*args)
+            sot[0] = SOTCapture(fn)
+            return sot[0](*args)
         return _wrap_pytree(out)
 
     wrapper._original_fn = fn
     wrapper._sot_fallen_back = fell_back
+    wrapper._sot_capture = sot
     return wrapper
 
 
@@ -189,12 +198,21 @@ def _make_layer_jit(layer, orig_forward):
     updates don't trigger recompiles; buffers update functionally."""
     jit_cache = {}
     fell_back = [False]
+    sot = [{}]  # training-mode -> SOTCapture
 
     def forward(*args, **kwargs):
-        if kwargs or fell_back[0]:
-            # kwargs would be baked into the trace as constants; ineligible
-            # frames run eagerly forever (SOT dygraph fallback)
+        if kwargs:
+            # kwargs would be baked into the trace as constants
             return orig_forward(*args, **kwargs)
+        if fell_back[0]:
+            # one capture per training mode: recorded segments bake the
+            # train/eval branch (dropout, BN stat source)
+            from .sot import SOTCapture
+
+            mode = bool(layer.training)
+            if sot[0].get(mode) is None:
+                sot[0][mode] = SOTCapture(orig_forward)
+            return sot[0][mode](*args)
         state = _ModuleState(layer)
         p_vals, b_vals = state.values()
         training = layer.training
@@ -221,13 +239,18 @@ def _make_layer_jit(layer, orig_forward):
         except Exception as e:
             if not _is_trace_ineligible(e):
                 raise
+            from .sot import SOTCapture
+
             fell_back[0] = True
-            return orig_forward(*args)
+            mode = bool(layer.training)
+            sot[0][mode] = SOTCapture(orig_forward)
+            return sot[0][mode](*args)
         for k, v in new_bufs.items():
             state.buffers[k]._value = v
         return _wrap_pytree(out)
 
     forward._sot_fallen_back = fell_back
+    forward._sot_capture = sot
     return forward
 
 

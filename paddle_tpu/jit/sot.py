@@ -1,0 +1,466 @@
+"""SOT graph-break capture: partial-graph compilation around data-dependent
+Python control flow.
+
+Reference analog: the reference SOT's opcode translator keeps compiling the
+traceable subgraphs AROUND a BreakGraphError instead of abandoning the frame
+(python/paddle/jit/sot/translate.py:97-106, sot/opcode_translator/). A frame
+with one `if tensor > 0:` still runs mostly compiled there; a whole-frame
+eager fallback loses ALL compilation for such frames.
+
+TPU-native mechanism — trace-by-recording rather than bytecode translation:
+
+1. RECORD: run the frame eagerly once with the op recorder + sync observer
+   installed. Every run_op lands in the current segment; every concrete
+   Tensor consumption by Python (`__bool__`/`__int__`/`__float__`/`item()`/
+   `numpy()`/`tolist()` — the ways data steers control flow) closes the
+   segment and records a GUARD (which value, what kind, what outcome).
+2. COMPILE: each segment becomes ONE jitted replay of its ops. Externals
+   (params, buffers) enter as runtime inputs, never baked constants, so
+   weight/buffer updates are visible and autograd reaches params.
+3. REPLAY: walk the guard tree — run segment 0 compiled, evaluate the guard
+   on its concrete result, take the child matching the outcome, continue.
+   An unseen outcome re-records a fresh path (guard-cached per split point).
+
+Safety valves (fall back to plain eager — the always-correct behavior):
+- a tensor created during recording by a path that bypasses run_op (nested
+  jit, host-side mutation) cannot be replayed -> capture disables itself;
+- array-valued guards larger than _MAX_GUARD_ELEMS;
+- guard-tree explosion (continuous float guards taking a fresh branch every
+  call) -> capture disables itself instead of re-recording forever.
+
+Known limitation: RNG draws inside recorded segments are frozen at record
+time (dropout masks replay identically); capture is keyed per layer
+training mode by the to_static integration.
+
+Values are named by deterministic value numbers (arg slot / op-output
+ordinal / external), so paths recorded in different runs share a consistent
+namespace for their common prefix. Segments execute through run_op, so the
+eager autograd tape sees each one as a single fused op — a frame with one
+dynamic branch runs as 2 compiled programs + 1 host sync instead of N eager
+dispatches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import core as _core
+from ..framework.core import (
+    Tensor,
+    run_op,
+    set_op_recorder,
+    set_sync_observer,
+    tracing_guard,
+)
+
+__all__ = ["SOTCapture"]
+
+_MAX_GUARD_ELEMS = 256   # array guards larger than this disable the capture
+_MAX_CHILDREN = 16       # per-node branch outcomes before disabling
+_MAX_WASTED_RECORDS = 16  # re-records with few replays => disable
+
+
+class _SOTUnsupported(Exception):
+    pass
+
+
+class _Segment:
+    """Ops between two graph breaks, entries referencing value numbers:
+    ("a", i) arg slot, ("v", n) earlier op output, ("e", j) grad-requiring
+    external, ("x", obj) constant external (buffer — passed as a live
+    runtime input, NOT a baked closure constant), ("c", arr) constant."""
+
+    def __init__(self, ops):
+        self.ops = ops  # (fn, entries, out_vnums)
+        need, produced, seen = [], [], set()
+        xs, xseen = [], set()
+        for _fn, entries, out_vnums in ops:
+            for e in entries:
+                if e[0] in ("a", "v", "e") and e[:2] not in seen \
+                        and e[:2] not in produced:
+                    need.append(e[:2])
+                    seen.add(e[:2])
+                elif e[0] == "x" and id(e[1]) not in xseen:
+                    xs.append(e[1])
+                    xseen.add(id(e[1]))
+            produced.extend(("v", n) for n in out_vnums)
+        self.needed = [e for e in need if e not in produced]
+        self.ext_objs = xs  # live tensors appended to the input list
+        self.produced = produced
+        needed = self.needed
+        n_named = len(needed)
+        x_index = {id(o): n_named + j for j, o in enumerate(xs)}
+
+        def replay(*vals):
+            local = dict(zip(needed, vals[:n_named]))
+
+            def get(e):
+                if e[0] == "c":
+                    return e[1]
+                if e[0] == "x":
+                    return vals[x_index[id(e[1])]]
+                return local[e[:2]]
+
+            with tracing_guard(True):
+                for fn, entries, out_vnums in ops:
+                    res = fn(*[get(e) for e in entries])
+                    res_list = res if isinstance(res, tuple) else [res]
+                    for n, val in zip(out_vnums, res_list):
+                        local[("v", n)] = val
+            return tuple(local[k] for k in produced)
+
+        # ONE XLA program per segment — run_op's cache bypasses closures of
+        # this shape, so jit here rather than relying on the dispatch cache
+        import jax
+
+        self._replay = jax.jit(replay)
+
+    def run(self, env):
+        args = [env[k] for k in self.needed] + self.ext_objs
+        produced = self.produced
+        outs = run_op("sot_segment", self._replay, args,
+                      n_outputs=len(produced) if len(produced) != 1 else None)
+        outs = outs if isinstance(outs, (tuple, list)) else [outs]
+        for k, t in zip(produced, outs):
+            env[k] = t
+
+
+class _Node:
+    __slots__ = ("segment", "guard", "children", "result_spec", "_ext")
+
+    def __init__(self):
+        self.segment = None      # _Segment (None until recorded)
+        self.guard = None        # (value_key_or_("x", obj), kind)
+        self.children = {}       # outcome -> _Node
+        self.result_spec = None  # terminal: pytree of value keys / constants
+
+
+def _outcome(kind, value):
+    if kind == "bool":
+        return bool(value)
+    if kind == "int":
+        return int(value)
+    if kind == "item":
+        return np.asarray(value).item()
+    if kind == "array":
+        arr = np.asarray(value)
+        if arr.size > _MAX_GUARD_ELEMS:
+            raise _SOTUnsupported(
+                f"array guard of {arr.size} elements")
+        return (arr.shape, arr.tobytes())
+    if isinstance(kind, tuple) and kind[0] == "cmp":
+        # guard on the branch predicate, not the continuous value: a float
+        # drawn from a training-evolving tensor repeats outcomes as long as
+        # the comparison result does
+        import operator
+
+        _, op, other = kind
+        if op == "truth":
+            return bool(float(value))
+        return bool(getattr(operator, op)(float(value), other))
+    return float(value)
+
+
+class _GuardedScalar(float):
+    """What float(tensor)/tensor.item() returns inside a recording.
+
+    Comparisons record their boolean outcome as the guard — the actual
+    branch predicate (`if float(loss) > t:` guards on the bool, so replays
+    survive the loss changing every step). Any other consumption
+    (arithmetic, formatting, hashing) pins the exact value instead, which
+    is always correct but re-records when the value drifts."""
+
+    def __new__(cls, value, session, key):
+        self = float.__new__(cls, value)
+        self._session = session
+        self._key = key
+        return self
+
+    def _cmp(self, op, other):
+        import operator
+
+        if not isinstance(other, (int, float, bool, np.number)):
+            return NotImplemented
+        if isinstance(other, _GuardedScalar):
+            other._escape()
+            other = float(other)
+        out = bool(getattr(operator, op)(float(self), other))
+        s = self._session
+        if s["active"]:
+            s["guard"](self._key, ("cmp", op, float(other)
+                                   if not isinstance(other, bool) else other),
+                       out)
+        return out
+
+    def __gt__(self, o):
+        return self._cmp("gt", o)
+
+    def __lt__(self, o):
+        return self._cmp("lt", o)
+
+    def __ge__(self, o):
+        return self._cmp("ge", o)
+
+    def __le__(self, o):
+        return self._cmp("le", o)
+
+    def __eq__(self, o):
+        return self._cmp("eq", o)
+
+    def __ne__(self, o):
+        return self._cmp("ne", o)
+
+    def __bool__(self):
+        s = self._session
+        out = float(self) != 0.0
+        if s["active"]:
+            s["guard"](self._key, ("cmp", "truth", None), out)
+        return out
+
+    def _escape(self):
+        s = self._session
+        if s["active"]:
+            s["guard"](self._key, "float", float(self))
+
+    def __hash__(self):
+        self._escape()
+        return float.__hash__(self)
+
+
+def _escaping(name):
+    base = getattr(float, name)
+
+    def method(self, *a):
+        self._escape()
+        return base(self, *a)
+
+    method.__name__ = name
+    return method
+
+
+for _m in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+           "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+           "__rfloordiv__", "__mod__", "__rmod__", "__pow__", "__rpow__",
+           "__neg__", "__pos__", "__abs__", "__round__", "__str__",
+           "__repr__", "__format__", "__int__", "__trunc__", "__floor__",
+           "__ceil__"):
+    setattr(_GuardedScalar, _m, _escaping(_m))
+
+
+class SOTCapture:
+    """Per-function graph-break capture with an (avals, outcomes) guard
+    tree. stats: record_runs (eager recording passes), replay_runs (fully
+    compiled executions), segments_run (compiled subgraphs executed)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.roots = {}  # avals key -> _Node
+        self.disabled = False  # permanent plain-eager fallback
+        self.stats = {"record_runs": 0, "replay_runs": 0, "segments_run": 0}
+
+    def _avals_key(self, args):
+        key = []
+        for a in args:
+            if isinstance(a, Tensor):
+                key.append(("t", tuple(a.shape), str(a._value.dtype)))
+            else:
+                key.append(("s", repr(a)))
+        return tuple(key)
+
+    def _disable(self):
+        self.disabled = True
+        self.roots.clear()
+
+    # ------------------------------------------------------------------ #
+
+    def _record(self, root, args):
+        """Run fn eagerly from the start, recording/overwriting the path its
+        guards take. Deterministic fn => a shared prefix re-records to
+        identical segments, so sibling paths stay consistent."""
+        self.stats["record_runs"] += 1
+        if (self.stats["record_runs"] > _MAX_WASTED_RECORDS
+                and self.stats["record_runs"]
+                > 4 * max(self.stats["replay_runs"], 1)):
+            # guards never repeat (continuous float guards): stop paying
+            # recording overhead and run plain eager permanently
+            self._disable()
+            return self.fn(*args)
+        names = {}  # id(tensor) -> value key
+        keep = []   # keep recorded tensors alive so ids stay unique
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                names[id(a)] = ("a", i)
+        counter = [0]
+        seg_ops = []
+        cur = {"node": root}
+        ext = getattr(root, "_ext", None)
+        if ext is None:
+            ext = []
+        root._ext = ext  # ("e", j) -> live tensor (grad-requiring external)
+        start_ctr = _core._tensor_ctr
+
+        def key_of(t):
+            k = names.get(id(t))
+            if k is not None:
+                return k
+            if not t.stop_gradient:
+                # grad-requiring external (parameter): pass as a segment
+                # INPUT so autograd reaches it and weight updates flow
+                for j, o in enumerate(ext):
+                    if o is t:
+                        names[id(t)] = ("e", j)
+                        return ("e", j)
+                ext.append(t)
+                k = ("e", len(ext) - 1)
+                names[id(t)] = k
+                return k
+            if t._ctr >= start_ctr:
+                if t._host_const:
+                    # materialized from host data during the frame (scalar
+                    # promotion, np constant): a true frame constant
+                    return ("c", np.asarray(t._value))
+                # produced during this recording by a path run_op did not
+                # see (nested jit): not replayable
+                raise _SOTUnsupported(
+                    "tensor created outside run_op during recording")
+            return ("x", t)  # pre-existing external (buffer): live input
+
+        def rec(name, fn, inputs, out):
+            entries = []
+            for i in inputs:
+                if isinstance(i, Tensor):
+                    entries.append(key_of(i))
+                else:
+                    entries.append(("c", np.asarray(i)))
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            out_vnums = []
+            for o in outs:
+                if isinstance(o, Tensor):
+                    n = counter[0]
+                    counter[0] += 1
+                    names[id(o)] = ("v", n)
+                    keep.append(o)
+                    out_vnums.append(n)
+            seg_ops.append((fn, entries, out_vnums))
+            if prev_rec is not None:  # chain an outer recorder (static)
+                prev_rec(name, fn, inputs, out)
+
+        session = {"active": True, "guard": None}
+
+        def split_guard(key, kind, outc):
+            node = cur["node"]
+            node.segment = _Segment(list(seg_ops))
+            seg_ops.clear()
+            node.guard = (key, kind)
+            child = node.children.get(outc)
+            if child is None:
+                if len(node.children) >= _MAX_CHILDREN:
+                    raise _SOTUnsupported("guard outcomes never repeat")
+                child = node.children[outc] = _Node()
+            cur["node"] = child
+
+        session["guard"] = split_guard
+
+        def observe(kind, tensor):
+            if kind == "item" and np.issubdtype(
+                    np.asarray(tensor._value).dtype, np.floating):
+                # defer the guard to the comparison on the returned scalar
+                # (`if loss.item() > t:` guards on the bool). float(t) can't
+                # get this treatment: CPython's float() coerces subclass
+                # returns to plain float (dropping the guard hooks), so it
+                # takes the exact-value guard below instead.
+                return _GuardedScalar(float(np.asarray(tensor._value)),
+                                      session, key_of(tensor))
+            split_guard(key_of(tensor), kind, _outcome(kind, tensor._value))
+            return None
+
+        def spec_of(out):
+            if isinstance(out, Tensor):
+                k = names.get(id(out))
+                return ("k", k) if k is not None else ("obj", out)
+            if isinstance(out, (list, tuple)):
+                return ("seq", type(out), [spec_of(o) for o in out])
+            if isinstance(out, dict):
+                return ("map", {kk: spec_of(v) for kk, v in out.items()})
+            return ("const", out)
+
+        prev_rec = _core._op_recorder
+        prev_obs = _core._sync_observer
+        set_op_recorder(rec)
+        set_sync_observer(observe)
+        try:
+            out = self.fn(*args)
+        except _SOTUnsupported as _e:
+            import os as _os
+
+            if _os.environ.get("SOT_DEBUG"):
+                import traceback as _tb
+
+                _tb.print_exc()
+            self._disable()
+            set_op_recorder(prev_rec)
+            set_sync_observer(prev_obs)
+            return self.fn(*args)
+        finally:
+            session["active"] = False
+            set_op_recorder(prev_rec)
+            set_sync_observer(prev_obs)
+        node = cur["node"]
+        node.segment = _Segment(list(seg_ops))
+        node.guard = None
+        node.result_spec = spec_of(out)
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _build_result(spec, env):
+        tag = spec[0]
+        if tag == "k":
+            return env[spec[1]]
+        if tag == "obj":
+            return spec[1]
+        if tag == "seq":
+            return spec[1](SOTCapture._build_result(s, env) for s in spec[2])
+        if tag == "map":
+            return {k: SOTCapture._build_result(v, env)
+                    for k, v in spec[1].items()}
+        return spec[1]
+
+    def __call__(self, *args):
+        if self.disabled:
+            return self.fn(*args)
+        key = self._avals_key(args)
+        root = self.roots.get(key)
+        if root is None:
+            root = self.roots[key] = _Node()
+            return self._record(root, args)
+
+        env = {}
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                env[("a", i)] = a
+        for j, o in enumerate(getattr(root, "_ext", [])):
+            env[("e", j)] = o  # live object: current param value + grad path
+        node = root
+        segs = 0
+        while True:
+            if node.segment is None:
+                return self._record(root, args)
+            node.segment.run(env)
+            segs += 1
+            if node.guard is None:
+                self.stats["replay_runs"] += 1
+                self.stats["segments_run"] += segs
+                return self._build_result(node.result_spec, env)
+            gkey, kind = node.guard
+            gval = gkey[1]._value if gkey[0] == "x" else env[gkey]._value
+            try:
+                child = node.children.get(_outcome(kind, gval))
+            except _SOTUnsupported:
+                self._disable()
+                return self.fn(*args)
+            if child is None:
+                # unseen branch outcome: record a fresh path
+                return self._record(root, args)
+            node = child
