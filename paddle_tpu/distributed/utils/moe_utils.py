@@ -3,16 +3,20 @@
 Reference: python/paddle/distributed/utils/moe_utils.py — ragged NCCL
 all-to-alls moving count-prefixed token buffers between expert-parallel ranks.
 
-TPU-native stance: the MoE layer (incubate/distributed/models/moe) routes with
-dense dispatch/combine einsums whose sharding constraints compile to XLA
-all-to-alls, so ragged runtime exchanges are unnecessary on the hot path.
-These functions exist for API parity: they implement the same global
-(src, expert)-grid transpose on the capacity-padded static layout.
+TPU-native stance: the MoE layer (incubate/distributed/models/moe) routes
+with dense dispatch/combine einsums whose sharding constraints compile to
+XLA all-to-alls, so ragged runtime exchanges are unnecessary on the hot
+path. These functions carry the REFERENCE semantics for eager callers:
+ragged per-expert counts are handled by padding every (rank, expert) block
+to the max count (the TPU-idiomatic static shape), riding ONE equal-split
+all-to-all, and compacting on the receive side — GShard capacity padding
+applied to the fastmoe wire format.
 
-Layout contract (static-shape analog of the reference's count arrays):
-`x` is [num_ranks * num_local_expert * capacity, d_model] — rank-major rows,
-i.e. row block (r, e) holds the tokens this rank routes to global expert
-r * num_local_expert + e, padded to `capacity`.
+Count contract (reference moe_utils.py): with n ranks and L local experts
+per rank (E = n*L global experts), `local_count[i]` is the number of rows
+this rank sends to global expert i (x's rows sorted by target expert) and
+`global_count[r*L + e]` is the number of rows received from rank r for
+local expert e.
 """
 
 from __future__ import annotations
@@ -22,47 +26,81 @@ import numpy as np
 from ..collective import _grp, alltoall_single
 
 
-def _check_uniform_counts(x, local_count, global_count, group):
-    """The static capacity-padded layout implies uniform counts; ragged
-    counts would silently land tokens in wrong expert rows — refuse loudly."""
+def _concrete_counts(c):
     import jax
 
-    n = _grp(group).nranks
-    rows = x.shape[0]
-    for name, c in (("local_count", local_count), ("global_count", global_count)):
-        if c is None:
-            continue
-        raw = c._value if hasattr(c, "_value") else c
-        if isinstance(raw, jax.core.Tracer):
-            continue  # traced counts: stay trace-safe, skip the eager check
-        arr = np.asarray(raw).ravel()
-        if arr.size == 0:
-            continue
-        if not (arr == arr[0]).all() or int(arr.sum()) != rows:
-            raise NotImplementedError(
-                f"{name} must be uniform with sum == x.shape[0] ({rows}) for "
-                "the TPU capacity-padded layout; ragged counts are handled by "
-                "the dense-dispatch MoE layer, not these compatibility shims"
-            )
+    if c is None:
+        return None
+    raw = c._value if hasattr(c, "_value") else c
+    if isinstance(raw, jax.core.Tracer):
+        return None
+    return np.asarray(raw).ravel().astype(np.int64)
+
+
+def _block_indices(counts, cap):
+    """Row indices of the compact rows inside a [E*cap, d] padded layout."""
+    if counts.sum() == 0:
+        return np.zeros((0,), np.int32)
+    return np.concatenate(
+        [np.arange(int(c), dtype=np.int32) + i * cap
+         for i, c in enumerate(counts)])
+
+
+def _ragged_exchange(x, send_counts, recv_counts, group):
+    """Pad (rank, expert) blocks to the max count, one equal-split
+    all-to-all, compact with the receive counts."""
+    import jax.numpy as jnp
+
+    from ...framework.core import Tensor
+    from ..collective import ReduceOp, all_reduce
+
+    d = x.shape[1]
+    cap = int(max(send_counts.max(initial=0), recv_counts.max(initial=0), 1))
+    # every rank must pad to the same capacity: one tiny MAX reduce (the
+    # reference exchanges its count arrays the same way)
+    capt = Tensor(jnp.asarray(cap, jnp.int32))
+    all_reduce(capt, op=ReduceOp.MAX, group=group)
+    cap = int(np.asarray(capt._value))
+    E = send_counts.size
+    sidx = _block_indices(send_counts, cap)
+    pad = jnp.zeros((E * cap, d), x._value.dtype)
+    if sidx.size:
+        pad = pad.at[jnp.asarray(sidx)].set(x._value[:sidx.size])
+    buf = Tensor(pad)
+    alltoall_single(buf, Tensor(pad), group=group)
+    ridx = _block_indices(recv_counts, cap)
+    return Tensor(buf._value[jnp.asarray(ridx)]) if ridx.size else \
+        Tensor(jnp.zeros((0, d), x._value.dtype))
 
 
 def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
     """Exchange token blocks so each rank receives the tokens routed to its
-    local experts (reference: moe_utils.py global_scatter). With the static
-    capacity-padded layout the exchange is exactly one equal-split all-to-all;
-    `local_count`/`global_count` are accepted for signature parity (counts are
-    implied by the padded layout)."""
-    _check_uniform_counts(x, local_count, global_count, group)
-    out = x.clone() if hasattr(x, "clone") else x
-    alltoall_single(out, x, group=group)
-    return out
+    local experts (reference: moe_utils.py global_scatter). Concrete counts
+    always take the capacity-padded exchange — its collective sequence (one
+    MAX reduce + one equal-split all-to-all) is identical on every rank
+    regardless of how ragged each rank's counts are, so ranks can never
+    diverge onto mismatched collectives. Uniform counts are just the
+    cap == count special case."""
+    sc = _concrete_counts(local_count)
+    rc = _concrete_counts(global_count)
+    if sc is None or rc is None:
+        # traced counts cannot steer a static-shape exchange — the compiled
+        # MoE path uses dense dispatch instead (incubate MoELayer); this
+        # raw equal-split exchange serves the capacity-padded layout
+        out = x.clone() if hasattr(x, "clone") else x
+        alltoall_single(out, x, group=group)
+        return out
+    return _ragged_exchange(x, sc, rc, group)
 
 
 def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
     """Inverse of global_scatter (reference: moe_utils.py global_gather) —
-    returns expert outputs to the ranks that own the tokens. The equal-split
-    all-to-all is self-inverse on the (src, dst) chunk grid."""
-    _check_uniform_counts(x, local_count, global_count, group)
-    out = x.clone() if hasattr(x, "clone") else x
-    alltoall_single(out, x, group=group)
-    return out
+    returns expert outputs to the ranks that own the tokens. Send blocks are
+    counted by `global_count`, receive blocks by `local_count`."""
+    sc = _concrete_counts(local_count)
+    rc = _concrete_counts(global_count)
+    if sc is None or rc is None:
+        out = x.clone() if hasattr(x, "clone") else x
+        alltoall_single(out, x, group=group)
+        return out
+    return _ragged_exchange(x, rc, sc, group)

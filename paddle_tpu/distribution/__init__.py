@@ -356,7 +356,7 @@ def _kl_bern_bern(p, q):
 # register KLs against the classes above)
 from .extras import (  # noqa: E402,F401
     Beta, Gamma, Dirichlet, Laplace, LogNormal, Multinomial, Geometric,
-    Gumbel, Cauchy, Poisson, StudentT, Binomial,
+    Gumbel, Cauchy, Poisson, StudentT, Binomial, Independent,
 )
 from . import transform  # noqa: E402,F401
 from .transform import (  # noqa: E402,F401
@@ -369,6 +369,7 @@ from .transform import (  # noqa: E402,F401
 __all__ += [
     "Beta", "Gamma", "Dirichlet", "Laplace", "LogNormal", "Multinomial",
     "Geometric", "Gumbel", "Cauchy", "Poisson", "StudentT", "Binomial",
+    "Independent",
     "transform", "Transform", "AbsTransform", "AffineTransform",
     "ChainTransform", "ExpTransform", "IndependentTransform",
     "PowerTransform", "ReshapeTransform", "SigmoidTransform",

@@ -22,7 +22,56 @@ from . import Distribution, Normal, _f32, _t, register_kl
 __all__ = [
     "Beta", "Gamma", "Dirichlet", "Laplace", "LogNormal", "Multinomial",
     "Geometric", "Gumbel", "Cauchy", "Poisson", "StudentT", "Binomial",
+    "Independent",
 ]
+
+
+class Independent(Distribution):
+    """reference: distribution/independent.py — reinterprets the rightmost
+    `reinterpreted_batch_rank` batch dims as event dims (log_prob sums over
+    them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        if self.rank > len(bshape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds base batch "
+                f"rank {len(bshape)}")
+        split = len(bshape) - self.rank
+        super().__init__(batch_shape=bshape[:split],
+                         event_shape=bshape[split:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def fn(v):
+            return v.sum(axis=tuple(range(-self.rank, 0)))
+
+        return run_op("independent_log_prob", fn, [lp])
+
+    def entropy(self):
+        ent = self.base.entropy()
+
+        def fn(v):
+            return v.sum(axis=tuple(range(-self.rank, 0)))
+
+        return run_op("independent_entropy", fn, [ent])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
 
 
 class Beta(Distribution):

@@ -263,6 +263,14 @@ class SOTCapture:
         for a in args:
             if isinstance(a, Tensor):
                 key.append(("t", tuple(a.shape), str(a._value.dtype)))
+            elif isinstance(a, np.ndarray):
+                # ndarray args enter recorded ops as baked constants, so the
+                # trace is only valid for identical CONTENT — key by digest,
+                # not repr (repr truncates large arrays)
+                import hashlib
+
+                key.append(("nd", a.shape, str(a.dtype),
+                            hashlib.sha1(a.tobytes()).hexdigest()))
             else:
                 key.append(("s", repr(a)))
         return tuple(key)
@@ -376,8 +384,19 @@ class SOTCapture:
 
         def spec_of(out):
             if isinstance(out, Tensor):
-                k = names.get(id(out))
-                return ("k", k) if k is not None else ("obj", out)
+                # key_of raises _SOTUnsupported for unreplayable tensors
+                # (nested-jit outputs) so the disable valve fires instead of
+                # replays returning a stale record-time value
+                k = key_of(out)
+                if k[0] in ("x",):
+                    return ("obj", k[1])  # pre-existing live object
+                if k[0] == "c":
+                    return ("const", out)
+                return ("k", k)
+            if isinstance(out, _GuardedScalar):
+                # scalar derived from a recorded tensor: rebuild from its
+                # source at replay, never bake the record-time value
+                return ("scalar", out._key)
             if isinstance(out, (list, tuple)):
                 return ("seq", type(out), [spec_of(o) for o in out])
             if isinstance(out, dict):
@@ -418,6 +437,12 @@ class SOTCapture:
         tag = spec[0]
         if tag == "k":
             return env[spec[1]]
+        if tag == "scalar":
+            k = spec[1]
+            if k[0] == "c":
+                return float(np.asarray(k[1]))
+            src = k[1] if k[0] == "x" else env[k]
+            return float(np.asarray(src._value))
         if tag == "obj":
             return spec[1]
         if tag == "seq":
@@ -454,7 +479,12 @@ class SOTCapture:
                 self.stats["segments_run"] += segs
                 return self._build_result(node.result_spec, env)
             gkey, kind = node.guard
-            gval = gkey[1]._value if gkey[0] == "x" else env[gkey]._value
+            if gkey[0] == "x":
+                gval = gkey[1]._value  # live external
+            elif gkey[0] == "c":
+                gval = gkey[1]  # baked host constant: outcome is fixed
+            else:
+                gval = env[gkey]._value
             try:
                 child = node.children.get(_outcome(kind, gval))
             except _SOTUnsupported:

@@ -123,6 +123,38 @@ def main():
     np.testing.assert_allclose(wr.numpy(), -g_r * scale, rtol=1e-5, atol=1e-6)
     dist.env.set_global_mesh(None)
 
+    # --- ragged MoE global_scatter/gather (capacity-padded exchange) ------ #
+    if world == 2:
+        from paddle_tpu.distributed.utils.moe_utils import (global_gather,
+                                                            global_scatter)
+
+        # 1 local expert per rank; rank0 sends [2 to e0, 1 to e1],
+        # rank1 sends [1 to e0, 2 to e1] — ragged on purpose
+        local_counts = {0: np.asarray([2, 1]), 1: np.asarray([1, 2])}
+        global_counts = {0: np.asarray([2, 1]), 1: np.asarray([1, 2])}
+        lc = local_counts[rank]
+        gc = global_counts[rank]
+        vals = (np.arange(lc.sum(), dtype=np.float32)[:, None]
+                + 100.0 * rank) * np.ones((1, 4), np.float32)
+        x_moe = paddle.to_tensor(vals)
+        y = global_scatter(x_moe, paddle.to_tensor(lc.astype(np.int64)),
+                           paddle.to_tensor(gc.astype(np.int64)))
+        assert y.shape[0] == int(gc.sum()), (rank, y.shape)
+        # receive layout: block (src_rank r): rank r's tokens for MY expert
+        if rank == 0:
+            # from r0: values [0, 1]; from r1: value [100]
+            expect = np.asarray([[0.0] * 4, [1.0] * 4, [100.0] * 4],
+                                np.float32)
+        else:
+            # from r0: value [2]; from r1: values [101, 102]
+            expect = np.asarray([[2.0] * 4, [101.0] * 4, [102.0] * 4],
+                                np.float32)
+        np.testing.assert_allclose(np.asarray(y.numpy()), expect, rtol=1e-6)
+        # gather is the exact inverse
+        back = global_gather(y, paddle.to_tensor(lc.astype(np.int64)),
+                             paddle.to_tensor(gc.astype(np.int64)))
+        np.testing.assert_allclose(np.asarray(back.numpy()), vals, rtol=1e-6)
+
     # --- hybrid dp x mp: the mp group is a SUBGROUP of the world, so the
     # distributed clip's reduction rides allreduce_value_group ------------- #
     if world >= 4 and world % 2 == 0:

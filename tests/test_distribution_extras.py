@@ -225,3 +225,22 @@ class TestTransforms:
         s = _v(td.sample((5000,)))
         assert s.shape == (5000,)
         assert (s > 0).all()
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert tuple(ind.batch_shape) == (3,)
+        assert tuple(ind.event_shape) == (4,)
+        x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        lp = _v(ind.log_prob(paddle.to_tensor(x)))
+        base_lp = _v(base.log_prob(paddle.to_tensor(x)))
+        np.testing.assert_allclose(lp, base_lp.sum(-1), rtol=1e-6)
+        np.testing.assert_allclose(_v(ind.entropy()),
+                                   _v(base.entropy()).sum(-1), rtol=1e-6)
+        paddle.seed(0)
+        assert tuple(ind.sample((5,)).shape) == (5, 3, 4)
+        with pytest.raises(ValueError):
+            D.Independent(base, 3)
