@@ -52,8 +52,13 @@ def _ragged_exchange(x, send_counts, recv_counts, group):
     import jax.numpy as jnp
 
     from ...framework.core import Tensor
+    from .. import eager_multiproc as _mp
     from ..collective import ReduceOp, all_reduce
 
+    if int(send_counts.sum()) != x.shape[0]:
+        raise ValueError(
+            f"count sum {int(send_counts.sum())} != rows {x.shape[0]} — "
+            "tokens would be silently dropped")
     d = x.shape[1]
     cap = int(max(send_counts.max(initial=0), recv_counts.max(initial=0), 1))
     # every rank must pad to the same capacity: one tiny MAX reduce (the
@@ -83,14 +88,7 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
     cap == count special case."""
     sc = _concrete_counts(local_count)
     rc = _concrete_counts(global_count)
-    if sc is None or rc is None:
-        # traced counts cannot steer a static-shape exchange — the compiled
-        # MoE path uses dense dispatch instead (incubate MoELayer); this
-        # raw equal-split exchange serves the capacity-padded layout
-        out = x.clone() if hasattr(x, "clone") else x
-        alltoall_single(out, x, group=group)
-        return out
-    return _ragged_exchange(x, sc, rc, group)
+    return _dispatch_exchange(x, sc, rc, group)
 
 
 def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
@@ -99,8 +97,34 @@ def global_gather(x, local_count, global_count, group=None, use_calc_stream=True
     counted by `global_count`, receive blocks by `local_count`."""
     sc = _concrete_counts(local_count)
     rc = _concrete_counts(global_count)
-    if sc is None or rc is None:
+    return _dispatch_exchange(x, rc, sc, group)
+
+
+def _dispatch_exchange(x, send_counts, recv_counts, group):
+    from .. import eager_multiproc as _mp
+
+    if send_counts is None or recv_counts is None:
+        # traced counts cannot steer a static-shape exchange — the compiled
+        # MoE path uses dense dispatch instead (incubate MoELayer); this
+        # raw equal-split exchange serves the capacity-padded layout
         out = x.clone() if hasattr(x, "clone") else x
         alltoall_single(out, x, group=group)
         return out
-    return _ragged_exchange(x, rc, sc, group)
+    if _mp.nprocs() > 1:
+        # multi-controller: ALWAYS the padded exchange, so every rank runs
+        # the identical collective sequence however ragged its own counts
+        return _ragged_exchange(x, send_counts, recv_counts, group)
+    # single controller holds the global stacked view; uniform counts ride
+    # the raw equal-split all-to-all, ragged ones have no meaningful
+    # single-process layout
+    uniform = (send_counts.size
+               and (send_counts == send_counts[0]).all()
+               and int(send_counts.sum()) == x.shape[0])
+    if uniform:
+        out = x.clone() if hasattr(x, "clone") else x
+        alltoall_single(out, x, group=group)
+        return out
+    raise NotImplementedError(
+        "ragged global_scatter/global_gather needs multi-controller "
+        "execution (jax.distributed); single-controller MoE uses the "
+        "dense-dispatch MoELayer / fused_moe path")

@@ -392,8 +392,9 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     traced function (all-expert einsums batch onto the MXU; when expert
     weights are mesh-sharded GSPMD inserts the all-to-alls). Capacity is
     4*ceil(topk*T/E) so drops are negligible at inference batch sizes; the
-    reference kernel is drop-free. Quantized paths (weight_only_int8 etc.)
-    and group_moe routing are not implemented.
+    reference kernel is drop-free. group_moe=True partitions the E experts
+    into moe_topk equal groups, softmaxes WITHIN each group and routes to
+    the top-1 expert per group (the ERNIE-MoE grouped-routing scheme).
 
     Shapes: x [B, S, M] or [T, M]; gate_weight [M, E];
     ffn1_weight [E, M, 2H] (swiglu layout: act on the FIRST half, matching
@@ -408,8 +409,6 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
             f"TPU (weight_only_int8 is)")
     if weight_only and (ffn1_scale is None or ffn2_scale is None):
         raise ValueError("weight_only_int8 requires ffn1_scale and ffn2_scale")
-    if group_moe:
-        raise NotImplementedError("fused_moe group_moe routing is not supported on TPU yet")
 
     from ...distributed.models.moe.gate import _topk_dispatch
 
@@ -435,7 +434,19 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         glu = w1.shape[-1] == 2 * w2.shape[1]
         cap = max(1, min(T, 4 * math.ceil(moe_topk * T / E)))
 
-        probs = jax.nn.softmax((xt @ gw).astype(jnp.float32), axis=-1)
+        logits = (xt @ gw).astype(jnp.float32)
+        if group_moe:
+            if E % moe_topk != 0:
+                raise ValueError(
+                    f"group_moe needs num_experts ({E}) divisible by "
+                    f"moe_topk ({moe_topk})")
+            Eg = E // moe_topk
+            gp = jax.nn.softmax(logits.reshape(T, moe_topk, Eg), axis=-1)
+            sel = jnp.argmax(gp, axis=-1)  # top-1 expert per group
+            probs = (gp * jax.nn.one_hot(sel, Eg, dtype=gp.dtype)
+                     ).reshape(T, E)
+        else:
+            probs = jax.nn.softmax(logits, axis=-1)
         combine, dispatch, _ = _topk_dispatch(probs, moe_topk, cap,
                                               normalize_topk=norm_topk_prob)
         dispatch = dispatch.astype(xt.dtype)

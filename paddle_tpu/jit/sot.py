@@ -425,9 +425,14 @@ class SOTCapture:
             set_op_recorder(prev_rec)
             set_sync_observer(prev_obs)
         node = cur["node"]
+        try:
+            spec = spec_of(out)  # raises _SOTUnsupported for unreplayable
+        except _SOTUnsupported:
+            self._disable()
+            return out
         node.segment = _Segment(list(seg_ops))
         node.guard = None
-        node.result_spec = spec_of(out)
+        node.result_spec = spec
         return out
 
     # ------------------------------------------------------------------ #

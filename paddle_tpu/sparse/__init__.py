@@ -40,10 +40,15 @@ __all__ = [
 
 class SparseTensor:
     """COO sparse tensor over BCOO (reference: the SparseCooTensor handle,
-    paddle/phi/core/sparse_coo_tensor.h)."""
+    paddle/phi/core/sparse_coo_tensor.h).
 
-    def __init__(self, bcoo):
+    `values_t` optionally carries the tape-connected values Tensor so
+    autograd flows through sparse layer outputs (values()/to_dense() then
+    participate in backward)."""
+
+    def __init__(self, bcoo, values_t=None):
         self._bcoo = bcoo
+        self._values_t = values_t
 
     # -- properties ---------------------------------------------------- #
 
@@ -63,6 +68,8 @@ class SparseTensor:
         return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
 
     def values(self):
+        if self._values_t is not None:
+            return self._values_t
         return Tensor(self._bcoo.data)
 
     def is_sparse(self):
@@ -74,6 +81,16 @@ class SparseTensor:
     # -- conversions --------------------------------------------------- #
 
     def to_dense(self):
+        if self._values_t is not None:
+            idx = self._bcoo.indices
+            shape = self._bcoo.shape
+
+            def fn(vals):
+                dense = jnp.zeros(shape, vals.dtype)
+                return dense.at[
+                    tuple(idx[:, d] for d in range(idx.shape[1]))].add(vals)
+
+            return run_op("sparse_to_dense", fn, [self._values_t])
         return Tensor(self._bcoo.todense())
 
     def numpy(self):
@@ -393,24 +410,22 @@ def transpose(x, perm):
 # --------------------------------------------------------------------------- #
 
 
-class _SparseReLU:
-    def __call__(self, x):
-        if isinstance(x, SparseCsrTensor):
-            return SparseCsrTensor(Tensor(x._crows), Tensor(x._cols),
-                                   Tensor(jnp.maximum(x._values, 0)),
-                                   x._shape)
-        bx = _as_bcoo(x)
-        return SparseTensor(jsparse.BCOO(
-            (jnp.maximum(bx.data, 0), bx.indices), shape=bx.shape))
-
-
 class _SparseNN:
-    ReLU = _SparseReLU
+    def __getattr__(self, name):
+        # layer classes (Conv3D, SubmConv3D, BatchNorm, MaxPool3D, ReLU,
+        # ...) live in nn_layers.py; resolve lazily to avoid import cycles
+        from . import nn_layers
+
+        if name in nn_layers.__all__:
+            return getattr(nn_layers, name)
+        raise AttributeError(name)
 
     class functional:
         @staticmethod
         def relu(x):
-            return _SparseReLU()(x)
+            from . import nn_layers
+
+            return nn_layers.ReLU()(x)
 
         @staticmethod
         def softmax(x, axis=-1):

@@ -1,0 +1,262 @@
+"""sparse.nn layers (reference: python/paddle/sparse/nn/ — layer/conv.py
+Conv3D/SubmConv3D :471/:184, layer/norm.py BatchNorm :27, layer/pooling.py
+MaxPool3D, layer/activation.py; kernels paddle/phi/kernels/sparse/gpu/
+conv_kernel.cu).
+
+TPU stance: the reference's gather-GEMM-scatter sparse convolution exists
+because GPU dense conv wastes FLOPs on empty voxels; the TPU is a
+dense-matrix machine whose conv path is the MXU, so sparse convs LOWER TO
+DENSE convolution (XLA conv_general_dilated) while keeping the sparse COO
+format at the API boundary. Submanifold convs mask the dense result back to
+the input's active sites — the defining SubmConv semantic. BatchNorm and
+activations operate on the [nnz, C] value rows directly (the reference's
+per-active-site semantics)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op
+from ..nn import Layer, ParamAttr
+from ..nn import initializer as I
+from . import SparseTensor, sparse_coo_tensor
+
+__all__ = ["Conv3D", "SubmConv3D", "Conv2D", "SubmConv2D", "BatchNorm",
+           "MaxPool3D", "ReLU", "LeakyReLU", "ReLU6", "Softmax"]
+
+
+def _dense_from_coo(st: SparseTensor):
+    """Tape-aware densify: rides SparseTensor.to_dense()'s _values_t path so
+    gradients flow through STACKED sparse layers, not just the last one."""
+    return st.to_dense()
+
+
+def _to_hybrid_coo(dense_t: Tensor, ndim_sparse):
+    """dense Tensor [N, *spatial, C] -> COO over the leading dims with
+    [nnz, C] values. The site gather runs through run_op so the returned
+    sparse tensor's values stay on the autograd tape."""
+    dense = dense_t._value
+    mask = jnp.any(dense != 0, axis=-1)
+    nnz = int(np.sum(np.asarray(mask)))
+    idx = jnp.stack(jnp.nonzero(mask, size=nnz))
+    idx_t = tuple(idx[d] for d in range(idx.shape[0]))
+    vals_t = run_op("sparse_gather_sites", lambda d: d[idx_t], [dense_t])
+    st = sparse_coo_tensor(Tensor(idx), Tensor(vals_t._value), dense.shape)
+    st._values_t = vals_t
+    return st
+
+
+class _SparseConvND(Layer):
+    _spatial = 3
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        n = self._spatial
+        ks = (kernel_size,) * n if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._stride = (stride,) * n if isinstance(stride, int) else tuple(stride)
+        self._padding = (padding,) * n if isinstance(padding, int) else tuple(padding)
+        self._dilation = (dilation,) * n if isinstance(dilation, int) else tuple(dilation)
+        self._groups = groups
+        self._subm = subm
+        if subm and (any(s != 1 for s in self._stride)):
+            raise ValueError("SubmConv requires stride 1 (sparsity-preserving)")
+        # weight layout [*ks, in/groups, out] (reference sparse conv layout)
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels // groups, out_channels],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True))
+
+    def forward(self, x: SparseTensor):
+        n = self._spatial
+        dn_spec = ("NDHWC", "DHWIO", "NDHWC") if n == 3 else \
+            ("NHWC", "HWIO", "NHWC")
+        stride, padding, dilation = self._stride, self._padding, self._dilation
+        groups, subm = self._groups, self._subm
+        has_bias = self.bias is not None
+        dense_in = _dense_from_coo(x)  # Tensor (tape-connected)
+        idx = x._bcoo.indices  # [nnz, n+1] (batch + spatial), static
+
+        def fn(dense, w, *rest):
+            out = jax.lax.conv_general_dilated(
+                dense, w,
+                window_strides=stride,
+                padding=[(p, p) for p in padding],
+                rhs_dilation=dilation,
+                dimension_numbers=dn_spec,
+                feature_group_count=groups,
+            )
+            if has_bias:
+                out = out + rest[0]
+            if subm:
+                # submanifold: only the input's active sites stay active
+                mask = jnp.zeros(out.shape[:-1], bool).at[
+                    tuple(idx[:, d] for d in range(idx.shape[1]))].set(True)
+                out = jnp.where(mask[..., None], out, 0.0)
+            return out
+
+        ins = [dense_in, self.weight]
+        if has_bias:
+            ins.append(self.bias)
+        out = run_op("sparse_conv", fn, ins)
+        return _to_hybrid_coo(out, n + 1)
+
+
+class Conv3D(_SparseConvND):
+    """reference: sparse/nn/layer/conv.py Conv3D :471 (NDHWC)."""
+
+    _spatial = 3
+
+
+class SubmConv3D(_SparseConvND):
+    """reference: sparse/nn/layer/conv.py SubmConv3D :184 — output sparsity
+    equals input sparsity."""
+
+    _spatial = 3
+
+    def __init__(self, *args, **kwargs):
+        kwargs["subm"] = True
+        super().__init__(*args, **kwargs)
+
+
+class Conv2D(_SparseConvND):
+    _spatial = 2
+
+
+class SubmConv2D(_SparseConvND):
+    _spatial = 2
+
+    def __init__(self, *args, **kwargs):
+        kwargs["subm"] = True
+        super().__init__(*args, **kwargs)
+
+
+class BatchNorm(Layer):
+    """reference: sparse/nn/layer/norm.py BatchNorm — normalizes the ACTIVE
+    value rows per channel (empty voxels do not contribute statistics)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x: SparseTensor):
+        b = x._bcoo
+        training = self.training
+        mom, eps = self._momentum, self._epsilon
+
+        def fn(vals, w, bias):
+            v32 = vals.astype(jnp.float32)
+            if training:
+                mu = v32.mean(0)
+                var = v32.var(0)
+            else:
+                mu = self._mean._value
+                var = self._variance._value
+            out = (v32 - mu) * jax.lax.rsqrt(var + eps) * w + bias
+            return out.astype(vals.dtype), mu, var
+
+        out_vals, mu_t, var_t = run_op(
+            "sparse_batch_norm", fn, [x.values(), self.weight, self.bias],
+            n_outputs=3)
+        if training:
+            # stats computed ONCE inside the op; running update on device
+            self._mean._value = (mom * self._mean._value
+                                 + (1 - mom) * mu_t._value)
+            self._variance._value = (mom * self._variance._value
+                                     + (1 - mom) * var_t._value)
+        import jax.experimental.sparse as jsparse
+
+        return SparseTensor(jsparse.BCOO((out_vals._value, b.indices),
+                                         shape=b.shape), values_t=out_vals)
+
+
+class MaxPool3D(Layer):
+    """reference: sparse/nn/layer/pooling.py MaxPool3D (NDHWC)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+        s = k if stride is None else (
+            (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+        p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        self._k, self._s, self._p = k, s, p
+
+    def forward(self, x: SparseTensor):
+        k, s, p = self._k, self._s, self._p
+        dense_t = _dense_from_coo(x)
+
+        def fn(d):
+            return jax.lax.reduce_window(
+                d, -jnp.inf, jax.lax.max,
+                window_dimensions=(1,) + k + (1,),
+                window_strides=(1,) + s + (1,),
+                padding=[(0, 0)] + [(pp, pp) for pp in p] + [(0, 0)])
+
+        pooled = run_op("sparse_max_pool3d", fn, [dense_t])
+        finite = run_op("sparse_pool_mask",
+                        lambda v: jnp.where(jnp.isfinite(v), v, 0.0),
+                        [pooled])
+        return _to_hybrid_coo(finite, 4)
+
+
+class _ValueAct(Layer):
+    _fn = staticmethod(lambda v: v)
+
+    def forward(self, x):
+        from . import SparseCsrTensor
+
+        fn = self._fn
+        if isinstance(x, SparseCsrTensor):
+            out = run_op("sparse_act", lambda v: fn(v), [x.values()])
+            return SparseCsrTensor(x.crows(), x.cols(), out, x.shape)
+        import jax.experimental.sparse as jsparse
+
+        b = x._bcoo
+        out = run_op("sparse_act", lambda v: fn(v), [x.values()])
+        return SparseTensor(jsparse.BCOO((out._value, b.indices),
+                                         shape=b.shape), values_t=out)
+
+
+class ReLU(_ValueAct):
+    _fn = staticmethod(lambda v: jnp.maximum(v, 0))
+
+
+class ReLU6(_ValueAct):
+    _fn = staticmethod(lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(_ValueAct):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        a = float(negative_slope)
+        self._fn = lambda v: jnp.where(v > 0, v, a * v)
+
+
+class Softmax(Layer):
+    """Defers to the existing per-lane sparse softmax."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from . import nn as _fns
+
+        return _fns.functional.softmax(x, axis=self._axis)

@@ -182,6 +182,41 @@ class TestFusedMoE:
                 ref[t] += w[j] * (h @ w2[e])
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
+    def test_fused_moe_group_routing(self):
+        """group_moe: per-group softmax + top-1 per group vs a numpy oracle."""
+        import paddle_tpu.incubate.nn.functional as IF
+        import jax
+
+        rng = np.random.RandomState(7)
+        E, M, H, T, K = 4, 8, 16, 12, 2
+        x = rng.randn(T, M).astype(np.float32) * 0.5
+        gw = rng.randn(M, E).astype(np.float32) * 0.5
+        w1 = rng.randn(E, M, 2 * H).astype(np.float32) * 0.1
+        w2 = rng.randn(E, H, M).astype(np.float32) * 0.1
+        got = IF.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                           paddle.to_tensor(w1), paddle.to_tensor(w2),
+                           moe_topk=K, group_moe=True).numpy()
+
+        Eg = E // K
+        logits = (x @ gw).reshape(T, K, Eg)
+        gp = np.asarray(jax.nn.softmax(logits.astype(np.float32), axis=-1))
+        ref = np.zeros_like(x)
+        for t in range(T):
+            sel = [(g, int(np.argmax(gp[t, g]))) for g in range(K)]
+            w = np.asarray([gp[t, g, e] for g, e in sel])
+            w = w / w.sum()  # norm_topk_prob default True
+            for wj, (g, e) in zip(w, sel):
+                eid = g * Eg + e
+                h = x[t] @ w1[eid]
+                u, gg = h[:H], h[H:]
+                h = np.asarray(jax.nn.silu(u)) * gg
+                ref[t] += wj * (h @ w2[eid])
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+        with pytest.raises(ValueError):
+            IF.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                         paddle.to_tensor(w1), paddle.to_tensor(w2),
+                         moe_topk=3, group_moe=True)
+
     def test_fused_moe_weight_only_int8(self):
         """weight_only_int8: int8 expert weights + per-out-channel scales
         reproduce the fp32 MoE within quantization error (reference cutlass

@@ -195,3 +195,86 @@ def test_spmv_spmm_eager_autograd():
     np.testing.assert_allclose(
         W.grad.numpy(), np.tile(s.to_dense().numpy().sum(0)[:, None], (1, 2)),
         rtol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# sparse.nn layers (reference: python/paddle/sparse/nn/)
+# --------------------------------------------------------------------------- #
+
+
+def _point_cloud(seed=0, N=1, D=6, H=6, W=6, C=3, n_pts=10):
+    rng = np.random.default_rng(seed)
+    dense = np.zeros((N, D, H, W, C), np.float32)
+    for _ in range(n_pts):
+        n, d, h, w = (rng.integers(0, s) for s in (N, D, H, W))
+        dense[n, d, h, w] = rng.normal(size=C).astype(np.float32) + 0.1
+    return dense
+
+
+def test_sparse_conv3d_matches_dense():
+    import jax
+    import paddle_tpu as pd
+
+    pd.seed(0)
+    dense = _point_cloud()
+    st = paddle.to_tensor(dense).to_sparse_coo(sparse_dim=4)
+    conv = sparse.nn.Conv3D(3, 5, kernel_size=3, padding=1)
+    out = conv(st)
+    ref = np.asarray(jax.lax.conv_general_dilated(
+        dense, np.asarray(conv.weight.numpy()), (1, 1, 1),
+        [(1, 1)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))
+    ref = ref + np.asarray(conv.bias.numpy())
+    np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_submconv3d_preserves_sparsity():
+    import paddle_tpu as pd
+
+    pd.seed(0)
+    dense = _point_cloud(seed=1)
+    st = paddle.to_tensor(dense).to_sparse_coo(sparse_dim=4)
+    conv = sparse.nn.SubmConv3D(3, 4, kernel_size=3, padding=1)
+    out = conv(st)
+    in_mask = np.any(dense != 0, axis=-1)
+    out_mask = np.any(out.to_dense().numpy() != 0, axis=-1)
+    # output active sites are a subset of the input's (submanifold semantic)
+    assert not np.any(out_mask & ~in_mask)
+
+
+def test_sparse_batchnorm_relu_pool():
+    import paddle_tpu as pd
+
+    pd.seed(0)
+    dense = _point_cloud(seed=2)
+    st = paddle.to_tensor(dense).to_sparse_coo(sparse_dim=4)
+    bn = sparse.nn.BatchNorm(3)
+    out = bn(st)
+    vals = out.values().numpy()
+    active = dense[np.any(dense != 0, axis=-1)]
+    # normalized over ACTIVE sites only
+    np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(vals.std(0), 1.0, atol=1e-2)
+    r = sparse.nn.ReLU()(out)
+    assert (r.values().numpy() >= 0).all()
+    lr = sparse.nn.LeakyReLU(0.1)(out)
+    assert np.isfinite(lr.values().numpy()).all()
+    p = sparse.nn.MaxPool3D(2)(st)
+    assert p.shape[1] == dense.shape[1] // 2
+    ref_pool = dense.reshape(1, 3, 2, 3, 2, 3, 2, 3).max((2, 4, 6))
+    np.testing.assert_allclose(p.to_dense().numpy(),
+                               np.maximum(ref_pool, 0.0) + np.minimum(ref_pool, 0.0),
+                               rtol=1e-5)
+
+
+def test_sparse_conv_grads_flow():
+    import paddle_tpu as pd
+
+    pd.seed(0)
+    dense = _point_cloud(seed=3)
+    st = paddle.to_tensor(dense).to_sparse_coo(sparse_dim=4)
+    conv = sparse.nn.SubmConv3D(3, 4, kernel_size=3, padding=1)
+    out = conv(st)
+    out.values().sum().backward()
+    assert conv.weight.grad is not None
+    assert np.abs(conv.weight.grad.numpy()).sum() > 0
