@@ -34,6 +34,31 @@ def _erf_np(x):
     return erf(x)
 
 
+
+
+def _put_np(x, v):
+    out = x.copy()
+    for r in range(3):
+        out[r, r] = v[r, 0]
+    return out
+
+
+def _renorm_np(x):
+    norms = np.linalg.norm(x, axis=1, keepdims=True)
+    scale = np.minimum(1.0 / np.maximum(norms, 1e-7), 1.0)
+    return x * scale
+
+
+def _smooth_l1_np(a, b, delta=1.0):
+    d = np.abs(a - b)
+    return np.mean(np.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta))
+
+
+_NAN_MASK = np.zeros((3, 4), bool)
+_NAN_MASK[0, 0] = _NAN_MASK[1, 2] = _NAN_MASK[2, 3] = True
+_NAN_FILL = np.where(
+    np.arange(12).reshape(3, 4) % 2 == 0, np.nan, np.inf).astype(np.float32)
+
 POS = S(low=0.1, high=3.0)
 UNIT = S(low=-0.9, high=0.9)
 NZ = S(avoid_zero=True)
@@ -264,6 +289,136 @@ REGISTRY = [
         [S(), S()]),
     _sp("l1_loss", F.l1_loss, lambda a, b: np.mean(np.abs(a - b)),
         [S(), S(low=3.0, high=5.0)]),
+    # ---- round-3 breadth batch ----------------------------------------- #
+    _sp("lerp", lambda a, b: paddle.lerp(a, b, 0.3),
+        lambda a, b: a + 0.3 * (b - a), [S(), S()]),
+    _sp("addmm", lambda c, a, b: paddle.addmm(c, a, b, beta=0.5, alpha=2.0),
+        lambda c, a, b: 0.5 * c + 2.0 * (a @ b),
+        [S((3, 5)), S((3, 4)), S((4, 5))]),
+    _sp("diag_embed", paddle.diag_embed,
+        lambda x: np.stack([np.diag(r) for r in x]), [S((3, 4))]),
+    _sp("diagonal", lambda x: paddle.diagonal(x),
+        lambda x: np.diagonal(x), [S((4, 4))]),
+    _sp("kthvalue", lambda x: paddle.kthvalue(x, 2)[0],
+        lambda x: np.sort(x, axis=-1)[..., 1]),
+    _sp("mode", lambda x: paddle.mode(x)[0],
+        lambda x: __import__("scipy.stats", fromlist=["mode"]).mode(
+            x, axis=-1, keepdims=False).mode,
+        [S((3, 8), dtype="int", low=0, high=3)], check_grad=False,
+        check_jit=False),  # host-side bincount path
+    _sp("masked_fill",
+        lambda x: paddle.masked_fill(x, paddle.to_tensor(
+            np.tile([True, False], 6).reshape(3, 4)), 7.0),
+        lambda x: np.where(np.tile([True, False], 6).reshape(3, 4), 7.0, x)),
+    _sp("index_fill",
+        lambda x: paddle.index_fill(
+            x, paddle.to_tensor(np.asarray([1], np.int32)), 0, 9.0),
+        lambda x: np.concatenate([x[:1], np.full((1, 4), 9.0), x[2:]])),
+    _sp("put_along_axis",
+        lambda x, v: paddle.put_along_axis(
+            x, paddle.to_tensor(np.asarray([[0], [1], [2]], np.int32)), v,
+            axis=1),
+        lambda x, v: _put_np(x, v), [S((3, 4)), S((3, 1))]),
+    _sp("gather_nd",
+        lambda x: paddle.gather_nd(x, paddle.to_tensor(
+            np.asarray([[0, 1], [2, 3]], np.int32))),
+        lambda x: x[[0, 2], [1, 3]]),
+    _sp("tensordot", lambda a, b: paddle.tensordot(a, b, axes=1),
+        lambda a, b: np.tensordot(a, b, axes=1), [S((3, 4)), S((4, 5))]),
+    _sp("dist", lambda a, b: paddle.dist(a, b, p=2),
+        lambda a, b: np.linalg.norm((a - b).ravel()), [S(), S()]),
+    _sp("det",
+        lambda x: paddle.linalg.det(
+            paddle.matmul(x, paddle.t(x)) + 3.0 * paddle.eye(3)),
+        lambda x: np.linalg.det(x @ x.T + 3.0 * np.eye(3)),
+        [S((3, 3))], fd_rtol=0.15),
+    _sp("solve",
+        lambda a, b: paddle.linalg.solve(
+            paddle.matmul(a, paddle.t(a)) + 3.0 * paddle.eye(3), b),
+        lambda a, b: np.linalg.solve(a @ a.T + 3.0 * np.eye(3), b),
+        [S((3, 3)), S((3, 2))], check_bf16=False, fd_rtol=0.12),
+    _sp("triangular_solve",
+        lambda a, b: paddle.linalg.triangular_solve(
+            paddle.tril(a) + 3.0 * paddle.eye(3), b, upper=False),
+        lambda a, b: np.linalg.solve(np.tril(a) + 3.0 * np.eye(3), b),
+        [S((3, 3)), S((3, 2))], check_bf16=False, fd_rtol=0.12),
+    _sp("bucketize",
+        lambda x: paddle.bucketize(x, paddle.to_tensor(
+            np.asarray([-1.0, 0.0, 1.0], np.float32))),
+        lambda x: np.searchsorted([-1.0, 0.0, 1.0], x.ravel()).reshape(
+            x.shape), check_grad=False),
+    _sp("histogram", lambda x: paddle.histogram(x, bins=4, min=-2, max=2),
+        lambda x: np.histogram(x, bins=4, range=(-2, 2))[0],
+        check_grad=False, check_bf16=False, check_jit=False),
+    _sp("nanmedian", paddle.nanmedian, np.nanmedian, [S((3, 5))],
+        check_grad=False),
+    _sp("frac", paddle.frac, lambda x: x - np.trunc(x), [NZ],
+        check_grad=False),
+    _sp("nan_to_num",
+        lambda x: paddle.nan_to_num(paddle.where(
+            paddle.to_tensor(_NAN_MASK), paddle.to_tensor(_NAN_FILL), x)),
+        lambda x: np.nan_to_num(np.where(_NAN_MASK, _NAN_FILL, x)),
+        check_grad=False),
+    _sp("heaviside", paddle.heaviside, np.heaviside, [NZ, S()],
+        check_grad=False),
+    _sp("ldexp", paddle.ldexp, np.ldexp,
+        [S(), S(dtype="int", low=0, high=3)], check_grad=False),
+    _sp("trapezoid", lambda y: paddle.trapezoid(y, dx=0.5),
+        lambda y: np.trapezoid(y, dx=0.5) if hasattr(np, "trapezoid")
+        else np.trapz(y, dx=0.5), [S((12,))]),
+    _sp("vander", lambda x: paddle.vander(x, 4),
+        lambda x: np.vander(x, 4), [S((5,))]),
+    _sp("expand_as", lambda a, b: paddle.expand_as(a, b),
+        lambda a, b: np.broadcast_to(a, b.shape), [S((1, 4)), S((3, 4))],
+        grad_args=[0]),
+    _sp("renorm", lambda x: paddle.renorm(x, 2.0, 0, 1.0),
+        _renorm_np, [S((3, 4))], fd_rtol=0.12),
+    _sp("logcumsumexp", paddle.logcumsumexp,
+        lambda x: np.log(np.cumsum(np.exp(x))), [S((10,))]),
+    _sp("cosine_similarity",
+        lambda a, b: F.cosine_similarity(a, b, axis=-1),
+        lambda a, b: (a * b).sum(-1)
+        / np.maximum(np.linalg.norm(a, axis=-1)
+                     * np.linalg.norm(b, axis=-1), 1e-8),
+        [S(), S()]),
+    _sp("pairwise_distance",
+        lambda a, b: F.pairwise_distance(a, b),
+        lambda a, b: np.linalg.norm(a - b + 1e-6, axis=-1),
+        [S(), S(low=3.0, high=5.0)], rtol=1e-4, atol=1e-4),
+    _sp("one_hot",
+        lambda i: F.one_hot(i, 6),
+        lambda i: np.eye(6, dtype=np.float32)[i],
+        [S((5,), dtype="int", low=0, high=6)], check_grad=False),
+    _sp("label_smooth",
+        lambda x: F.label_smooth(x, epsilon=0.1),
+        lambda x: x * 0.9 + 0.1 / x.shape[-1], [S((3, 4), low=0, high=1)]),
+    _sp("nll_loss",
+        lambda lp: F.nll_loss(lp, paddle.to_tensor(
+            np.asarray([0, 2, 1], np.int64))),
+        lambda lp: -np.mean([lp[0, 0], lp[1, 2], lp[2, 1]]),
+        [S((3, 4), low=-3, high=-0.1)]),
+    _sp("kl_div",
+        lambda lp, t: F.kl_div(lp, t, reduction="mean"),
+        lambda lp, t: np.mean(t * (np.log(t) - lp)),
+        [S((3, 4), low=-3, high=-0.5), S((3, 4), low=0.1, high=1.0)]),
+    _sp("smooth_l1_loss",
+        lambda a, b: F.smooth_l1_loss(a, b),
+        lambda a, b: _smooth_l1_np(a, b), [S(), S(low=3.0, high=5.0)]),
+    _sp("linear_fn",
+        lambda x, w, b: F.linear(x, w, b),
+        lambda x, w, b: x @ w + b, [S((3, 4)), S((4, 5)), S((5,))]),
+    _sp("log_sigmoid", F.log_sigmoid,
+        lambda x: -np.log1p(np.exp(-x))),
+    _sp("celu", lambda x: F.celu(x, alpha=1.5),
+        lambda x: np.where(x > 0, x, 1.5 * np.expm1(x / 1.5)), [NZ]),
+    _sp("thresholded_relu", lambda x: F.thresholded_relu(x, threshold=0.25),
+        lambda x: np.where(x > 0.25, x, 0.0), [NZ]),
+    # threshold 0.25 keeps the |x| >= 0.3 inputs clear of the kink for FD
+    _sp("softshrink", lambda x: F.softshrink(x, threshold=0.25),
+        lambda x: np.where(x > 0.25, x - 0.25,
+                           np.where(x < -0.25, x + 0.25, 0)), [NZ]),
+    _sp("hardshrink", lambda x: F.hardshrink(x, threshold=0.25),
+        lambda x: np.where(np.abs(x) > 0.25, x, 0.0), [NZ]),
 ]
 
 _IDS = [s.name for s in REGISTRY]
@@ -277,9 +432,9 @@ def test_op_sweep(spec):
 
 def test_registry_breadth():
     """The sweep must stay seeded across the Tensor-method surface."""
-    assert len(REGISTRY) >= 110
+    assert len(REGISTRY) >= 150
     with_grad = [s for s in REGISTRY if s.check_grad]
-    assert len(with_grad) >= 75
+    assert len(with_grad) >= 100
 
 
 def test_harness_catches_planted_wrong_grad():
