@@ -275,6 +275,188 @@ def einsum(equation, *operands):
     return run_op("einsum", lambda *vs: jnp.einsum(equation, *vs), ts)
 
 
+# ----------------------------------------------------------------------- #
+# linalg tail (reference: python/paddle/tensor/linalg.py cond :? ,
+# matrix_exp, vector_norm/matrix_norm, householder_product :?, ormqr,
+# svd_lowrank/pca_lowrank — randomized low-rank per Halko et al. 2011)
+# ----------------------------------------------------------------------- #
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(v):
+        v = v.astype(jnp.float32)
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum(v != 0, axis=axis, keepdims=keepdim).astype(
+                jnp.float32)
+        else:
+            out = jnp.sum(jnp.abs(v) ** p, axis=axis,
+                          keepdims=keepdim) ** (1.0 / p)
+        return out
+
+    return run_op("vector_norm", fn, [_t(x)])
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def fn(v):
+        v32 = v.astype(jnp.float32)
+        # normalize to the last-two-dims layout so every p below (including
+        # the SVD-based ones) reduces the requested axes
+        a0 = axis[0] % v32.ndim
+        a1 = axis[1] % v32.ndim
+        v32 = jnp.moveaxis(v32, (a0, a1), (-2, -1))
+        if p == "fro":
+            out = jnp.sqrt(jnp.sum(v32 * v32, axis=(-2, -1)))
+        elif p == "nuc":
+            s = jnp.linalg.svd(v32, compute_uv=False)
+            out = s.sum(-1)
+        elif p in (1, 1.0):
+            out = jnp.max(jnp.sum(jnp.abs(v32), axis=-2), axis=-1)
+        elif p in (np.inf, float("inf")):
+            out = jnp.max(jnp.sum(jnp.abs(v32), axis=-1), axis=-1)
+        elif p in (2, 2.0):
+            s = jnp.linalg.svd(v32, compute_uv=False)
+            out = s.max(-1)
+        else:
+            raise ValueError(f"unsupported matrix norm order {p!r}")
+        if keepdim:
+            out = jnp.expand_dims(jnp.expand_dims(out, a1 if a1 < a0 else a0),
+                                  a0 if a1 < a0 else a1)
+        return out
+
+    return run_op("matrix_norm", fn, [_t(x)])
+
+
+def cond(x, p=None, name=None):
+    """reference: linalg.cond — ||A|| * ||A^-1|| (2-norm default via
+    singular values)."""
+    def fn(v):
+        v32 = v.astype(jnp.float32)
+        if p is None or p in (2, 2.0):
+            s = jnp.linalg.svd(v32, compute_uv=False)
+            return s.max(-1) / s.min(-1)
+        if p == "fro":
+            inv = jnp.linalg.inv(v32)
+            return (jnp.sqrt((v32 * v32).sum((-2, -1)))
+                    * jnp.sqrt((inv * inv).sum((-2, -1))))
+        if p in (np.inf, float("inf"), 1, 1.0):
+            # 1-norm = max column sum (reduce rows, axis -2);
+            # inf-norm = max row sum (reduce columns, axis -1)
+            ax = -2 if p in (1, 1.0) else -1
+            inv = jnp.linalg.inv(v32)
+            return (jnp.abs(v32).sum(ax).max(-1)
+                    * jnp.abs(inv).sum(ax).max(-1))
+        raise ValueError(f"unsupported cond order {p!r}")
+
+    return run_op("cond", fn, [_t(x)])
+
+
+def matrix_exp(x, name=None):
+    from jax.scipy.linalg import expm
+
+    return run_op("matrix_exp", lambda v: expm(v.astype(jnp.float32)),
+                  [_t(x)])
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return run_op("vecdot",
+                  lambda a, b: jnp.sum(a * b, axis=axis), [_t(x), _t(y)])
+
+
+def _householder_q_full(a, t):
+    """Full m x m Q = H_0 H_1 ... from reflectors in a's lower triangle
+    (LAPACK orgqr accumulation)."""
+    m = a.shape[-2]
+    k = t.shape[-1]  # number of reflectors = tau length (may be < n)
+    ident = jnp.eye(m, dtype=a.dtype)
+    q = jnp.broadcast_to(ident, a.shape[:-2] + (m, m))
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m) > i, a[..., :, i], 0.0)
+        v = v.at[..., i].set(1.0)
+        vv = v[..., :, None] * v[..., None, :]
+        h = ident - t[..., i][..., None, None] * vv
+        return q @ h
+
+    return jax.lax.fori_loop(0, k, body, q)
+
+
+def householder_product(x, tau, name=None):
+    """reference: linalg.householder_product (LAPACK orgqr) — the first n
+    columns of the accumulated Q."""
+    def fn(a, t):
+        return _householder_q_full(a, t)[..., :, :a.shape[-1]]
+
+    return run_op("householder_product", fn, [_t(x), _t(tau)])
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """reference: linalg.ormqr — multiply `other` by the FULL Q of a QR
+    factorization (LAPACK ormqr semantics: other is [m, k] for left)."""
+    def fn(a, t, ov):
+        q = _householder_q_full(a, t)
+        qm = jnp.swapaxes(q, -2, -1) if transpose else q
+        return qm @ ov if left else ov @ qm
+
+    return run_op("ormqr", fn, [_t(x), _t(tau), _t(other)])
+
+
+def _lowrank(v, q, key, niter=2):
+    """Randomized range finder (Halko et al. 2011) — the reference's
+    svd_lowrank/pca_lowrank backbone; all dense matmuls (MXU-native)."""
+    m, n = v.shape[-2], v.shape[-1]
+    omega = jax.random.normal(key, v.shape[:-2] + (n, q), v.dtype)
+    y = v @ omega
+    for _ in range(niter):
+        y = v @ (jnp.swapaxes(v, -2, -1) @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -2, -1) @ v
+    u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u, s, jnp.swapaxes(vt, -2, -1)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    from ..framework import random as _rnd
+
+    key = _rnd.next_key()
+
+    def fn(v, *rest):
+        vv = v.astype(jnp.float32)
+        if rest:
+            vv = vv - rest[0].astype(jnp.float32)
+        return _lowrank(vv, min(q, min(vv.shape[-2:])), key, niter)
+
+    ins = [_t(x)] + ([_t(M)] if M is not None else [])
+    return run_op("svd_lowrank", fn, ins, n_outputs=3)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from ..framework import random as _rnd
+
+    key = _rnd.next_key()
+
+    def fn(v):
+        vv = v.astype(jnp.float32)
+        if center:
+            vv = vv - vv.mean(-2, keepdims=True)
+        k = q if q is not None else min(6, *vv.shape[-2:])
+        return _lowrank(vv, min(k, min(vv.shape[-2:])), key, niter)
+
+    return run_op("pca_lowrank", fn, [_t(x)], n_outputs=3)
+
+
+__all__ += ["vector_norm", "matrix_norm", "cond", "matrix_exp", "vecdot",
+            "householder_product", "ormqr", "svd_lowrank", "pca_lowrank"]
+
+# aliases living elsewhere in the tensor namespace (reference exports them
+# from linalg too)
+from .extras import lu_unpack, matrix_transpose, multi_dot  # noqa: E402,F401
+
+__all__ += ["lu_unpack", "matrix_transpose", "multi_dot"]
+
 for _name in __all__:
-    if _name not in ("einsum",):
+    if _name not in ("einsum", "lu_unpack", "matrix_transpose", "multi_dot"):
         register_tensor_method(_name, globals()[_name])

@@ -378,3 +378,113 @@ class TestFlops:
 
         total = paddle.flops(LeNet(), [1, 1, 28, 28])
         assert total > 1e5
+
+
+class TestLinalgTail:
+    def test_norms_and_cond(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 5)).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(
+            float(paddle.linalg.vector_norm(t, 2).numpy()),
+            np.linalg.norm(a.ravel()), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.linalg.vector_norm(t, float("inf")).numpy()),
+            np.abs(a).max(), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(paddle.linalg.matrix_norm(t, "fro").numpy()),
+            np.linalg.norm(a, "fro"), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.linalg.matrix_norm(t, "nuc").numpy()),
+            np.linalg.norm(a, "nuc"), rtol=1e-4)
+        sq = paddle.to_tensor(a[:4, :4] + 4 * np.eye(4, dtype=np.float32))
+        np.testing.assert_allclose(
+            float(paddle.linalg.cond(sq).numpy()),
+            np.linalg.cond(np.asarray(sq.numpy())), rtol=1e-4)
+
+    def test_matrix_exp_and_vecdot(self):
+        from scipy.linalg import expm
+
+        rng = np.random.default_rng(1)
+        a = (rng.normal(size=(3, 3)) * 0.3).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_exp(paddle.to_tensor(a)).numpy(),
+            expm(a), rtol=1e-4, atol=1e-5)
+        x = rng.normal(size=(2, 5)).astype(np.float32)
+        y = rng.normal(size=(2, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.vecdot(paddle.to_tensor(x),
+                                 paddle.to_tensor(y)).numpy(),
+            (x * y).sum(-1), rtol=1e-5)
+
+    def test_householder_product_and_ormqr(self):
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(5, 3)).astype(np.float32)
+        # LAPACK geqrf output via scipy: reflectors in qr_mat's lower part
+        from scipy.linalg import qr as _sqr
+
+        (qr_mat, tau), _r = _sqr(a, mode="raw")
+        q_econ = _sqr(a, mode="economic")[0]
+        got = paddle.linalg.householder_product(
+            paddle.to_tensor(np.asarray(qr_mat, np.float32)),
+            paddle.to_tensor(np.asarray(tau, np.float32))).numpy()
+        np.testing.assert_allclose(got, q_econ, rtol=1e-4, atol=1e-4)
+        # ormqr applies the FULL Q to other [m, k]
+        other = rng.normal(size=(5, 2)).astype(np.float32)
+        om = paddle.linalg.ormqr(
+            paddle.to_tensor(np.asarray(qr_mat, np.float32)),
+            paddle.to_tensor(np.asarray(tau, np.float32)),
+            paddle.to_tensor(other)).numpy()
+        q_full = _sqr(a, mode="full")[0]
+        np.testing.assert_allclose(om, q_full @ other, rtol=1e-4, atol=1e-4)
+
+    def test_lowrank(self):
+        rng = np.random.default_rng(3)
+        # rank-2 matrix + tiny noise: lowrank svd recovers it
+        u = rng.normal(size=(20, 2)).astype(np.float32)
+        v = rng.normal(size=(2, 15)).astype(np.float32)
+        a = u @ v
+        paddle.seed(0)
+        U, s, V = paddle.linalg.svd_lowrank(paddle.to_tensor(a), q=4)
+        rec = (U.numpy() * s.numpy()) @ V.numpy().T
+        np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-3)
+        U2, s2, V2 = paddle.linalg.pca_lowrank(paddle.to_tensor(a), q=3)
+        assert s2.shape[-1] == 3
+
+    def test_linalg_aliases(self):
+        assert paddle.linalg.matrix_transpose is not None
+        assert paddle.linalg.multi_dot is not None
+        assert paddle.linalg.lu_unpack is not None
+
+    def test_cond_orders_and_matrix_norm_axes(self):
+        rng = np.random.default_rng(5)
+        a = (rng.normal(size=(3, 3)) + 3 * np.eye(3)).astype(np.float32)
+        t = paddle.to_tensor(a)
+        for p in (1, np.inf, "fro", None):
+            np.testing.assert_allclose(
+                float(paddle.linalg.cond(t, p).numpy()),
+                np.linalg.cond(a, p if p is not None else 2), rtol=1e-4)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        got = paddle.linalg.matrix_norm(paddle.to_tensor(x), p=1,
+                                        axis=(0, 1)).numpy()
+        ref = np.abs(x).sum(0).max(0)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_householder_partial_tau(self):
+        rng = np.random.default_rng(6)
+        a = rng.normal(size=(6, 4)).astype(np.float32)
+        from scipy.linalg import qr as _sqr
+
+        (qr_mat, tau), _ = _sqr(a, mode="raw")
+        # only k=2 reflectors: Q accumulates H_0 H_1 only
+        got = paddle.linalg.householder_product(
+            paddle.to_tensor(np.asarray(qr_mat, np.float32)),
+            paddle.to_tensor(np.asarray(tau[:2], np.float32))).numpy()
+        ident = np.eye(6, dtype=np.float64)
+        q_ref = ident.copy()
+        for i in range(2):
+            v = np.zeros(6)
+            v[i] = 1.0
+            v[i + 1:] = qr_mat[i + 1:, i]
+            q_ref = q_ref @ (ident - tau[i] * np.outer(v, v))
+        np.testing.assert_allclose(got, q_ref[:, :4], rtol=1e-4, atol=1e-4)
