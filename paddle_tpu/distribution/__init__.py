@@ -357,6 +357,7 @@ def _kl_bern_bern(p, q):
 from .extras import (  # noqa: E402,F401
     Beta, Gamma, Dirichlet, Laplace, LogNormal, Multinomial, Geometric,
     Gumbel, Cauchy, Poisson, StudentT, Binomial, Independent,
+    MultivariateNormal,
 )
 from . import transform  # noqa: E402,F401
 from .transform import (  # noqa: E402,F401
@@ -369,7 +370,7 @@ from .transform import (  # noqa: E402,F401
 __all__ += [
     "Beta", "Gamma", "Dirichlet", "Laplace", "LogNormal", "Multinomial",
     "Geometric", "Gumbel", "Cauchy", "Poisson", "StudentT", "Binomial",
-    "Independent",
+    "Independent", "MultivariateNormal",
     "transform", "Transform", "AbsTransform", "AffineTransform",
     "ChainTransform", "ExpTransform", "IndependentTransform",
     "PowerTransform", "ReshapeTransform", "SigmoidTransform",

@@ -22,8 +22,95 @@ from . import Distribution, Normal, _f32, _t, register_kl
 __all__ = [
     "Beta", "Gamma", "Dirichlet", "Laplace", "LogNormal", "Multinomial",
     "Geometric", "Gumbel", "Cauchy", "Poisson", "StudentT", "Binomial",
-    "Independent",
+    "Independent", "MultivariateNormal",
 ]
+
+
+class MultivariateNormal(Distribution):
+    """reference: distribution/multivariate_normal.py
+    MultivariateNormal(loc, covariance_matrix | precision_matrix |
+    scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _f32(loc)
+        given = [a for a in (covariance_matrix, precision_matrix, scale_tril)
+                 if a is not None]
+        if len(given) != 1:
+            raise ValueError(
+                "exactly one of covariance_matrix / precision_matrix / "
+                "scale_tril must be given")
+        if scale_tril is not None:
+            self.scale_tril = _f32(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _f32(covariance_matrix)
+            self.scale_tril = Tensor(jnp.linalg.cholesky(cov._value))
+        else:
+            prec = _f32(precision_matrix)
+            self.scale_tril = Tensor(
+                jnp.linalg.cholesky(jnp.linalg.inv(prec._value)))
+        d = self.scale_tril._value.shape[-1]
+        batch = jnp.broadcast_shapes(self.loc._value.shape[:-1],
+                                     self.scale_tril._value.shape[:-2])
+        super().__init__(batch_shape=batch, event_shape=(d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        def fn(L):
+            return L @ jnp.swapaxes(L, -2, -1)
+
+        return run_op("mvn_cov", fn, [self.scale_tril])
+
+    @property
+    def variance(self):
+        return run_op("mvn_var",
+                      lambda L: jnp.sum(L * L, axis=-1), [self.scale_tril])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+
+        def fn(loc, L):
+            eps = jax.random.normal(key, shp, dtype=loc.dtype)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return run_op("mvn_rsample", fn, [self.loc, self.scale_tril])
+
+    def log_prob(self, value):
+        def fn(v, loc, L):
+            d = L.shape[-1]
+            diff = v - loc
+            # broadcast BOTH operands to the common batch shape (value may
+            # have sample dims, scale_tril may carry batch dims)
+            batch = jnp.broadcast_shapes(diff.shape[:-1], L.shape[:-2])
+            diff = jnp.broadcast_to(diff, batch + diff.shape[-1:])
+            Lb = jnp.broadcast_to(L, batch + L.shape[-2:])
+            z = jax.scipy.linalg.solve_triangular(
+                Lb, diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(z * z, axis=-1)
+            logdet = jnp.sum(
+                jnp.log(jnp.diagonal(Lb, axis1=-2, axis2=-1)), axis=-1)
+            return (-0.5 * maha - logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+
+        return run_op("mvn_log_prob", fn,
+                      [_f32(value), self.loc, self.scale_tril])
+
+    def entropy(self):
+        def fn(L):
+            d = L.shape[-1]
+            logdet = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+
+        return run_op("mvn_entropy", fn, [self.scale_tril])
 
 
 class Independent(Distribution):

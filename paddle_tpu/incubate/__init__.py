@@ -6,3 +6,6 @@ from . import nn  # noqa: F401
 __all__ = ["nn"]
 from . import distributed  # noqa: F401
 __all__.append("distributed")
+from . import optimizer  # noqa: E402,F401
+from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
+__all__ += ["optimizer", "LookAhead", "ModelAverage"]

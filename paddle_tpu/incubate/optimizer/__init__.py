@@ -1,0 +1,153 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/ —
+lookahead.py LookAhead, modelaverage.py ModelAverage; LBFGS and the fused
+LAMB live in paddle.optimizer / the ZeRO-sharded update respectively)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import no_grad
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+def _flat_params(plist):
+    out = []
+    for p in plist or []:
+        out.extend(p["params"] if isinstance(p, dict) else [p])
+    return out
+
+
+class LookAhead:
+    """reference: incubate/optimizer/lookahead.py — k fast steps with the
+    inner optimizer, then slow weights move alpha toward the fast weights
+    and the fast weights reset to the slow ones (Zhang et al. 2019)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None  # param id -> slow-weight value
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_optimizer"], name)
+
+    @no_grad()
+    def step(self):
+        params = _flat_params(self.inner_optimizer._parameter_list)
+        if self._slow is None:
+            self._slow = {id(p): p._value for p in params}
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in params:
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    slow = p._value
+                slow = slow + self.alpha * (p._value - slow)
+                p._value = slow
+                self._slow[id(p)] = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_step"] = self._step_count
+        if self._slow is not None:
+            # slow weights are core LookAhead state: without them a resume
+            # re-snapshots from the fast weights and changes the trajectory
+            import numpy as np
+
+            params = _flat_params(self.inner_optimizer._parameter_list)
+            sd["@lookahead_slow"] = [
+                np.asarray(self._slow[id(p)]) for p in params]
+        return sd
+
+    def set_state_dict(self, state):
+        self._step_count = state.pop("@lookahead_step", 0)
+        slow = state.pop("@lookahead_slow", None)
+        out = self.inner_optimizer.set_state_dict(state)
+        if slow is not None:
+            params = _flat_params(self.inner_optimizer._parameter_list)
+            self._slow = {id(p): jnp.asarray(v)
+                          for p, v in zip(params, slow)}
+        return out
+
+
+class ModelAverage:
+    """reference: incubate/optimizer/modelaverage.py — running average of
+    parameter values over a trailing window; apply()/restore() swap the
+    averaged weights in for evaluation."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._params = _flat_params(parameters)
+        self._sum = {id(p): jnp.zeros_like(p._value) for p in self._params}
+        self._num = 0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        """Accumulate the current parameter values (call after the real
+        optimizer's step). The trailing window restarts when the
+        accumulation count exceeds min(max_average_window,
+        num_updates * average_window_rate) — the reference's rate-scaled
+        window."""
+        self._updates = getattr(self, "_updates", 0) + 1
+        self._num += 1
+        window = min(self.max_average_window,
+                     max(self.min_average_window,
+                         int(self._updates * self.average_window)))
+        restart = self._num > window
+        for p in self._params:
+            if restart:
+                self._sum[id(p)] = p._value
+            else:
+                self._sum[id(p)] = self._sum[id(p)] + p._value
+        if restart:
+            self._num = 1
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params:
+            p.clear_grad()
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Swap the averaged weights in (context-manager style supported)."""
+        if self._num == 0:
+            return self
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            p._value = (self._sum[id(p)] / self._num).astype(p._value.dtype)
+        self._need_restore = need_restore
+        return self
+
+    @no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._value = self._backup[id(p)]
+        self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False

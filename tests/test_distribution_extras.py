@@ -244,3 +244,40 @@ class TestIndependent:
         assert tuple(ind.sample((5,)).shape) == (5, 3, 4)
         with pytest.raises(ValueError):
             D.Independent(base, 3)
+
+
+class TestMultivariateNormal:
+    def test_scipy_parity_and_sampling(self):
+        rng = np.random.default_rng(0)
+        A = rng.normal(size=(3, 3)).astype(np.float32)
+        cov = A @ A.T + 2 * np.eye(3, dtype=np.float32)
+        loc = np.asarray([1.0, -0.5, 2.0], np.float32)
+        d = D.MultivariateNormal(loc, covariance_matrix=cov)
+        x = rng.normal(size=(5, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            _v(d.log_prob(paddle.to_tensor(x))),
+            st.multivariate_normal.logpdf(x, loc, cov), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(_v(d.entropy())),
+            st.multivariate_normal.entropy(loc, cov), rtol=1e-5)
+        np.testing.assert_allclose(_v(d.covariance_matrix), cov, rtol=1e-4)
+        np.testing.assert_allclose(_v(d.variance), np.diag(cov), rtol=1e-4)
+        paddle.seed(3)
+        s = _v(d.sample((30000,)))
+        np.testing.assert_allclose(s.mean(0), loc, atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.15)
+
+    def test_precision_and_scale_tril_ctor(self):
+        cov = np.asarray([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        d1 = D.MultivariateNormal(np.zeros(2, np.float32),
+                                  covariance_matrix=cov)
+        d2 = D.MultivariateNormal(np.zeros(2, np.float32),
+                                  precision_matrix=np.linalg.inv(cov))
+        d3 = D.MultivariateNormal(np.zeros(2, np.float32),
+                                  scale_tril=np.linalg.cholesky(cov))
+        x = paddle.to_tensor(np.asarray([0.3, -0.7], np.float32))
+        for d in (d2, d3):
+            np.testing.assert_allclose(_v(d.log_prob(x)),
+                                       _v(d1.log_prob(x)), rtol=1e-4)
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(np.zeros(2, np.float32))

@@ -26,7 +26,8 @@ BENCH_CONFIG=gpt3_125m timeout 1800 python bench.py | tee "$OUT/bench_125m.json"
 
 log "2. Pallas kernel validation on real Mosaic (512x512 blocks)"
 timeout 2400 python -m pytest tests/test_pallas_kernels.py tests/test_masked_flash.py -x -q \
-  2>&1 | tail -5 | tee "$OUT/kernel_validation.txt"
+  2>&1 | tee "$OUT/kernel_validation.txt" | tail -5
+echo "kernel validation rc=${PIPESTATUS[0]}" | tee -a "$OUT/kernel_validation.txt"
 
 log "3. per-component perf breakdown"
 timeout 2400 python tools/perf_breakdown.py gpt3_125m | tee "$OUT/breakdown_125m.json"
