@@ -745,3 +745,12 @@ from .fused_attention_ops import (  # noqa: E402,F401
 
 __all__ += ["fused_attention", "fused_multi_head_attention",
             "fused_feedforward", "fused_bias_dropout_residual_layer_norm"]
+
+from .fused_misc_ops import (  # noqa: E402,F401
+    fused_dot_product_attention,
+    fused_gate_attention,
+    fused_matmul_bias,
+)
+
+__all__ += ["fused_dot_product_attention", "fused_gate_attention",
+            "fused_matmul_bias"]

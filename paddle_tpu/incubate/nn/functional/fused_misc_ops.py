@@ -1,0 +1,152 @@
+"""fused_dot_product_attention / fused_gate_attention / fused_matmul_bias
+(reference: python/paddle/incubate/nn/functional/
+fused_dot_product_attention.py:129 (cudnn fused attention),
+fused_gate_attention.py:26 (the AlphaFold gate attention mega-op,
+fusion/gpu/fused_gate_attention_op.cu), fused_matmul_bias.py:31
+(cublasLt gemm epilogue)).
+
+TPU formulation: single traced compositions — XLA fuses the bias/gating
+epilogues into the dots (the role of cublasLt epilogues / the hand-written
+CUDA mega-kernel); the maskless dropoutless attention core rides the Pallas
+flash kernel via scaled_dot_product_attention."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "fused_dot_product_attention",
+    "fused_gate_attention",
+    "fused_matmul_bias",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def fused_dot_product_attention(query, key, value, attn_mask=None,
+                                dropout_p=0.0, is_causal=False,
+                                scaling_factor=None, training=True,
+                                name=None):
+    """reference: fused_dot_product_attention.py:129 — q/k/v
+    [B, S, H, D]; additive float mask; routes to the same SDPA core as
+    nn.functional (Pallas flash when maskless + dropoutless)."""
+    from ....nn.functional.flash_attention import scaled_dot_product_attention
+
+    if scaling_factor is None:
+        return scaled_dot_product_attention(
+            query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+            is_causal=is_causal, training=training)
+    # custom scale: fold it into q (SDPA uses 1/sqrt(D) internally)
+    d = _t(query).shape[-1]
+    q = _t(query) * (scaling_factor * (d ** 0.5))
+    return scaled_dot_product_attention(
+        q, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False):
+    """reference: fused_gate_attention.py:26 — the AlphaFold Evoformer
+    attention block: per-head projections over [n, b, q, a] activations,
+    optional nonbatched bias, sigmoid gating, output projection."""
+    if out_linear_weight is None:
+        raise ValueError("out_linear_weight is required")
+    if has_gating and (gate_linear_weight is None or gate_linear_bias is None):
+        raise ValueError(
+            "has_gating=True requires gate_linear_weight and "
+            "gate_linear_bias")
+    if merge_qkv:
+        if qkv_weight is None:
+            raise ValueError("merge_qkv=True requires qkv_weight")
+        m = query if key is None else key
+        opt = {"gate_w": gate_linear_weight, "gate_b": gate_linear_bias,
+               "nb_bias": nonbatched_bias, "mask": attn_mask}
+    else:
+        if query_weight is None or key_weight is None or value_weight is None:
+            raise ValueError(
+                "merge_qkv=False requires query/key/value weights")
+        m = query if key is None else key
+        opt = {"qw": query_weight, "kw": key_weight, "vw": value_weight,
+               "gate_w": gate_linear_weight, "gate_b": gate_linear_bias,
+               "nb_bias": nonbatched_bias, "mask": attn_mask}
+    names = [k for k, v in opt.items() if v is not None]
+    ins = [_t(query), _t(m)]
+    if merge_qkv:
+        ins.append(_t(qkv_weight))
+    ins += [_t(opt[k]) for k in names]
+    ow = _t(out_linear_weight)
+    ob = _t(out_linear_bias) if out_linear_bias is not None else None
+    ins.append(ow)
+    if ob is not None:
+        ins.append(ob)
+
+    def fn(q_data, m_data, *rest):
+        it = iter(rest)
+        if merge_qkv:
+            qkv_w = next(it)
+        o = {k: next(it) for k in names}
+        out_w = next(it)
+        out_b = next(it, None)
+        if merge_qkv:
+            # qkv_w [3, H, D, A]
+            q = jnp.einsum("nbqa,hda->nbqhd", q_data, qkv_w[0])
+            k = jnp.einsum("nbka,hda->nbkhd", m_data, qkv_w[1])
+            v = jnp.einsum("nbka,hda->nbkhd", m_data, qkv_w[2])
+        else:
+            q = jnp.einsum("nbqa,ahd->nbqhd", q_data, o["qw"])
+            k = jnp.einsum("nbka,ahd->nbkhd", m_data, o["kw"])
+            v = jnp.einsum("nbka,ahd->nbkhd", m_data, o["vw"])
+        d = q.shape[-1]
+        logits = jnp.einsum("nbqhd,nbkhd->nbhqk", q * (d ** -0.5), k)
+        logits = logits.astype(jnp.float32)
+        if "mask" in o:
+            m = o["mask"]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, -1e30)  # keep-mask convention
+            elif jnp.issubdtype(m.dtype, jnp.integer):
+                logits = jnp.where(m != 0, logits, -1e30)
+            else:
+                logits = logits + m.astype(jnp.float32)
+        if "nb_bias" in o:
+            logits = logits + o["nb_bias"].astype(jnp.float32)[:, None]
+        w = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        ctx = jnp.einsum("nbhqk,nbkhd->nbqhd", w, v)
+        if has_gating:
+            gate = jnp.einsum("nbqa,ahd->nbqhd", q_data, o["gate_w"])
+            gate = gate + o["gate_b"]
+            ctx = ctx * jax.nn.sigmoid(gate)
+        out = jnp.einsum("nbqhd,hdo->nbqo", ctx, out_w)
+        if out_b is not None:
+            out = out + out_b
+        return out
+
+    return run_op("fused_gate_attention", fn, ins)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: fused_matmul_bias.py:31 (cublasLt epilogue) — XLA fuses
+    the bias add into the dot."""
+    ins = [_t(x), _t(y)] + ([_t(bias)] if bias is not None else [])
+
+    def fn(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return run_op("fused_matmul_bias", fn, ins)

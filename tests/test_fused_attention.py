@@ -202,3 +202,116 @@ def test_bias_dropout_residual_ln():
     layer = FusedBiasDropoutResidualLayerNorm(E, dropout_rate=0.0)
     out_l = layer(paddle.to_tensor(x), paddle.to_tensor(res))
     assert out_l.shape == [B, S, E]
+
+
+class TestFusedMiscOps:
+    def test_fused_matmul_bias(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(3, 4)).astype(np.float32)
+        w = rng.normal(size=(4, 5)).astype(np.float32)
+        b = rng.normal(size=(5,)).astype(np.float32)
+        out = IF.fused_matmul_bias(paddle.to_tensor(a), paddle.to_tensor(w),
+                                   paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ w + b, rtol=1e-5)
+        out2 = IF.fused_matmul_bias(paddle.to_tensor(a.T),
+                                    paddle.to_tensor(w),
+                                    transpose_x=True)
+        np.testing.assert_allclose(out2.numpy(), a @ w, rtol=1e-5)
+
+    def test_fused_dot_product_attention_matches_sdpa(self):
+        rng = np.random.default_rng(1)
+        q = rng.normal(size=(2, 6, 4, 8)).astype(np.float32)
+        out = IF.fused_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True, dropout_p=0.0)
+        ref = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        # custom scaling factor changes the result
+        out2 = IF.fused_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True, scaling_factor=1.0)
+        assert not np.allclose(out2.numpy(), ref.numpy())
+
+    def test_fused_gate_attention_oracle(self):
+        """AlphaFold gate-attention pseudo-code oracle (reference
+        fused_gate_attention.py:49-68), merged-qkv + separate-weight paths."""
+        rng = np.random.default_rng(2)
+        n, b, q_len, a, h, d = 2, 3, 5, 8, 2, 4
+        q_data = rng.normal(size=(n, b, q_len, a)).astype(np.float32)
+        qw = rng.normal(size=(a, h, d)).astype(np.float32) * 0.3
+        kw = rng.normal(size=(a, h, d)).astype(np.float32) * 0.3
+        vw = rng.normal(size=(a, h, d)).astype(np.float32) * 0.3
+        gw = rng.normal(size=(a, h, d)).astype(np.float32) * 0.3
+        gb = rng.normal(size=(h, d)).astype(np.float32) * 0.1
+        ow = rng.normal(size=(h, d, a)).astype(np.float32) * 0.3
+        ob = rng.normal(size=(a,)).astype(np.float32) * 0.1
+        nb_bias = rng.normal(size=(n, h, q_len, q_len)).astype(np.float32)
+
+        def oracle():
+            c = d ** -0.5
+            qq = np.einsum("nbqa,ahc->nbqhc", q_data, qw) * c
+            kk = np.einsum("nbka,ahc->nbkhc", q_data, kw)
+            vv = np.einsum("nbka,ahc->nbkhc", q_data, vw)
+            logits = np.einsum("nbqhc,nbkhc->nbhqk", qq, kk)
+            logits = logits + nb_bias[:, None]
+            w = np.exp(logits - logits.max(-1, keepdims=True))
+            w = w / w.sum(-1, keepdims=True)
+            avg = np.einsum("nbhqk,nbkhc->nbqhc", w, vv)
+            gate = 1 / (1 + np.exp(-(np.einsum("nbqc,chv->nbqhv", q_data, gw)
+                                     + gb)))
+            avg = avg * gate
+            return np.einsum("nbqhc,hco->nbqo", avg, ow) + ob
+
+        got = IF.fused_gate_attention(
+            paddle.to_tensor(q_data),
+            query_weight=paddle.to_tensor(qw), key_weight=paddle.to_tensor(kw),
+            value_weight=paddle.to_tensor(vw),
+            gate_linear_weight=paddle.to_tensor(gw),
+            gate_linear_bias=paddle.to_tensor(gb),
+            out_linear_weight=paddle.to_tensor(ow),
+            out_linear_bias=paddle.to_tensor(ob),
+            nonbatched_bias=paddle.to_tensor(nb_bias),
+            has_gating=True, merge_qkv=False)
+        np.testing.assert_allclose(got.numpy(), oracle(), rtol=2e-4,
+                                   atol=2e-4)
+        # merged-qkv layout [3, H, D, A] must agree with the separate path
+        qkv_w = np.stack([np.transpose(qw, (1, 2, 0)),
+                          np.transpose(kw, (1, 2, 0)),
+                          np.transpose(vw, (1, 2, 0))])
+        got2 = IF.fused_gate_attention(
+            paddle.to_tensor(q_data), qkv_weight=paddle.to_tensor(qkv_w),
+            gate_linear_weight=paddle.to_tensor(gw),
+            gate_linear_bias=paddle.to_tensor(gb),
+            out_linear_weight=paddle.to_tensor(ow),
+            out_linear_bias=paddle.to_tensor(ob),
+            nonbatched_bias=paddle.to_tensor(nb_bias),
+            has_gating=True, merge_qkv=True)
+        np.testing.assert_allclose(got2.numpy(), got.numpy(), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_fused_gate_attention_validation_and_bool_mask(self):
+        rng = np.random.default_rng(3)
+        q_data = paddle.to_tensor(
+            rng.normal(size=(1, 2, 4, 8)).astype(np.float32))
+        qkv_w = paddle.to_tensor(
+            rng.normal(size=(3, 2, 4, 8)).astype(np.float32) * 0.3)
+        ow = paddle.to_tensor(
+            rng.normal(size=(2, 4, 8)).astype(np.float32) * 0.3)
+        with pytest.raises(ValueError):
+            IF.fused_gate_attention(q_data, qkv_weight=qkv_w)  # no out weight
+        with pytest.raises(ValueError):
+            IF.fused_gate_attention(q_data, qkv_weight=qkv_w,
+                                    out_linear_weight=ow)  # gating w missing
+        # bool keep-mask masks keys out (parity with the additive -inf form)
+        keep = np.ones((1, 2, 2, 4, 4), bool)
+        keep[..., -1] = False
+        out_b = IF.fused_gate_attention(
+            q_data, qkv_weight=qkv_w, out_linear_weight=ow,
+            attn_mask=paddle.to_tensor(keep), has_gating=False)
+        add = np.where(keep, 0.0, -1e30).astype(np.float32)
+        out_f = IF.fused_gate_attention(
+            q_data, qkv_weight=qkv_w, out_linear_weight=ow,
+            attn_mask=paddle.to_tensor(add), has_gating=False)
+        np.testing.assert_allclose(out_b.numpy(), out_f.numpy(), rtol=1e-5)
