@@ -39,15 +39,32 @@ __all__ = ["flash_attention_fwd", "flash_attention"]
 NEG_INF = -1e30
 
 
-def _block_sizes(sq, skv):
+def _block_sizes(sq, skv, d=None):
     """Default tile sizes. Large blocks matter more than MXU-perfect ones on
     TPU: the grid is executed sequentially per core, so per-step fixed costs
     (DMA issue, scalar bookkeeping) are amortized by block area. 128x128
     blocks on a 2048-seq 12-head model produce ~25k grid steps per kernel
     and leave the kernel latency-bound — 512x512 cuts that 16x while using
-    <3MB of the 16MB VMEM (q/k/v/acc tiles at D<=128)."""
-    bq = min(512, -(-max(8, sq) // 8) * 8)  # round up to sublane multiple
-    bk = min(512, -(-max(8, skv) // 8) * 8)
+    <3MB of the 16MB VMEM (q/k/v/acc tiles at D<=128). Head dims >=256
+    halve the cap to stay inside VMEM with double buffering.
+
+    PADDLE_TPU_FLASH_BLOCK=<n> overrides the cap (hardware escape hatch —
+    e.g. =128 restores the round-2 tiling without a code change)."""
+    import os
+
+    try:
+        env_cap = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK", "0"))
+    except ValueError:
+        env_cap = 0
+    if env_cap > 0:
+        # explicit override: round to a legal sublane multiple, clamp >= 8
+        cap = max(8, env_cap // 8 * 8)
+    else:
+        cap = 512
+        if d is not None and d >= 256:
+            cap = 256  # VMEM headroom for wide heads
+    bq = min(cap, -(-max(8, sq) // 8) * 8)  # round up to sublane multiple
+    bk = min(cap, -(-max(8, skv) // 8) * 8)
     return bq, bk
 
 
@@ -134,7 +151,7 @@ def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None):
     B, H, Sqp, D = q.shape
     _, Hkv, Skvp, _ = k.shape
     if bq is None or bk is None:
-        bq, bk = _block_sizes(Sqp, Skvp)
+        bq, bk = _block_sizes(Sqp, Skvp, d=D)
     nq = Sqp // bq
     nk = Skvp // bk
     group = H // Hkv
@@ -368,7 +385,7 @@ def _tuned_blocks(q, k, v, causal, scale):
     from .autotune import autotune_enabled, pick_block_sizes
 
     sq, skv = q.shape[2], k.shape[2]
-    default = _block_sizes(sq, skv)
+    default = _block_sizes(sq, skv, d=q.shape[-1])
     if not autotune_enabled():
         return default
 

@@ -234,11 +234,10 @@ def _fm_specs(B, H, Hm, Hkv, n, bq, bk, D):
     ]
 
 
-def _fm_fwd(q, k, v, idx, scale, causal, sq, skv):
+def _fm_fwd(q, k, v, idx, scale, causal, sq, skv, bq, bk):
     B, H, Sqp, D = q.shape
     _, Hkv, Skvp, _ = k.shape
     Hm, n = idx.shape[1], idx.shape[2]
-    bq, bk = _block_sizes(Sqp, Skvp)
     nq, nk = Sqp // bq, Skvp // bk
 
     kernel = functools.partial(
@@ -265,12 +264,14 @@ def _fm_fwd(q, k, v, idx, scale, causal, sq, skv):
     )(q, k, v, idx)
 
 
-def _fm_bwd(scale, causal, sq, skv, residuals, dout):
+def _fm_bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
+    # (bq, bk) are the FORWARD's block sizes threaded through the custom-VJP
+    # statics — recomputing here could diverge (env override changing
+    # mid-run) and leave bwd grid rows unwritten
     q, k, v, idx, out, lse = residuals
     B, H, Sqp, D = q.shape
     _, Hkv, Skvp, _ = k.shape
     Hm, n = idx.shape[1], idx.shape[2]
-    bq, bk = _block_sizes(Sqp, Skvp)
     nq, nk = Sqp // bq, Skvp // bk
     group = H // Hkv
 
@@ -328,15 +329,14 @@ def _fm_bwd(scale, causal, sq, skv, residuals, dout):
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flashmask(q, k, v, idx, causal, scale):
-    out, _ = _flashmask_fwd_res(q, k, v, idx, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flashmask(q, k, v, idx, causal, scale, bq, bk):
+    out, _ = _flashmask_fwd_res(q, k, v, idx, causal, scale, bq, bk)
     return out
 
 
-def _flashmask_fwd_res(q, k, v, idx, causal, scale):
+def _flashmask_fwd_res(q, k, v, idx, causal, scale, bq, bk):
     sq, skv = q.shape[2], k.shape[2]
-    bq, bk = _block_sizes(sq, skv)
     qp = _pad_seq(q, bq)
     kp = _pad_seq(k, bk)
     vp = _pad_seq(v, bk)
@@ -344,20 +344,20 @@ def _flashmask_fwd_res(q, k, v, idx, causal, scale):
     # padded key columns are dropped by the (col < skv) term in the keep mask,
     # so the pad value for idx does not matter
     idxp = jnp.pad(idx, ((0, 0), (0, 0), (0, 0), (0, pad_k)))
-    out, lse = _fm_fwd(qp, kp, vp, idxp, scale, causal, sq, skv)
+    out, lse = _fm_fwd(qp, kp, vp, idxp, scale, causal, sq, skv, bq, bk)
     return out[:, :, :sq], (qp, kp, vp, idxp, out, lse)
 
 
-def _flashmask_vjp_fwd(q, k, v, idx, causal, scale):
-    out, res = _flashmask_fwd_res(q, k, v, idx, causal, scale)
+def _flashmask_vjp_fwd(q, k, v, idx, causal, scale, bq, bk):
+    out, res = _flashmask_fwd_res(q, k, v, idx, causal, scale, bq, bk)
     return out, (res, q.shape[2], k.shape[2])
 
 
-def _flashmask_vjp_bwd(causal, scale, saved, dout):
+def _flashmask_vjp_bwd(causal, scale, bq, bk, saved, dout):
     res, sq, skv = saved
     qp = res[0]
     dop = jnp.pad(dout, ((0, 0), (0, 0), (0, qp.shape[2] - sq), (0, 0)))
-    dq, dk, dv = _fm_bwd(scale, causal, sq, skv, res, dop)
+    dq, dk, dv = _fm_bwd(scale, causal, sq, skv, res, dop, bq, bk)
     return dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv], None
 
 
@@ -374,7 +374,8 @@ def flashmask_attention_fwd(q, k, v, startend_row_indices, causal=True,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     idx = jnp.moveaxis(startend_row_indices.astype(jnp.int32), 2, 3)  # [B,Hm,n,Sk]
-    out = _flashmask(qt, kt, vt, idx, causal, scale)
+    bq, bk = _block_sizes(qt.shape[2], kt.shape[2], d=qt.shape[-1])
+    out = _flashmask(qt, kt, vt, idx, causal, scale, bq, bk)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -565,10 +566,10 @@ def _vl_specs(bq, bk, D, group, transpose_grid=False):
     ]
 
 
-def _vl_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, scale, causal, tq, tk):
+def _vl_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, scale, causal, tq, tk,
+            bq, bk):
     H, Tqp, D = q.shape
     Hkv, Tkp, _ = k.shape
-    bq, bk = _block_sizes(Tqp, Tkp)
     nq, nk = Tqp // bq, Tkp // bk
     group = H // Hkv
     return pl.pallas_call(
@@ -593,15 +594,16 @@ def _vl_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, scale, causal, tq, tk):
     )(q, k, v, seg_q, seg_k, pos_q, pos_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
-def _varlen(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale):
-    out, _ = _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _varlen(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale, bq, bk):
+    out, _ = _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal,
+                             scale, bq, bk)
     return out
 
 
-def _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale):
+def _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale,
+                    bq, bk):
     tq, tk = q.shape[1], k.shape[1]
-    bq, bk = _block_sizes(tq, tk)
     qp = _pad_tokens(q, bq)
     kp = _pad_tokens(k, bk)
     vp = _pad_tokens(v, bk)
@@ -611,20 +613,23 @@ def _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale):
     skp = _pad_vec(seg_k.astype(jnp.int32), bk, -2)[None, :]
     pqp = _pad_vec(pos_q.astype(jnp.int32), bq, 0)[:, None]
     pkp = _pad_vec(pos_k.astype(jnp.int32), bk, 0)[None, :]
-    out, lse = _vl_fwd(qp, kp, vp, sqp, skp, pqp, pkp, scale, causal, tq, tk)
+    out, lse = _vl_fwd(qp, kp, vp, sqp, skp, pqp, pkp, scale, causal, tq,
+                       tk, bq, bk)
     return out[:, :tq], (qp, kp, vp, sqp, skp, pqp, pkp, out, lse)
 
 
-def _varlen_vjp_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale):
-    out, res = _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale)
+def _varlen_vjp_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale,
+                    bq, bk):
+    out, res = _varlen_fwd_res(q, k, v, seg_q, seg_k, pos_q, pos_k, causal,
+                               scale, bq, bk)
     return out, (res, q.shape[1], k.shape[1])
 
 
-def _varlen_vjp_bwd(causal, scale, saved, dout):
+def _varlen_vjp_bwd(causal, scale, bq, bk, saved, dout):
+    # forward's block sizes arrive as custom-VJP statics — never recomputed
     (qp, kp, vp, sqp, skp, pqp, pkp, outp, lse), tq, tk = saved
     H, Tqp, D = qp.shape
     Hkv, Tkp, _ = kp.shape
-    bq, bk = _block_sizes(Tqp, Tkp)
     nq, nk = Tqp // bq, Tkp // bk
     group = H // Hkv
     dop = jnp.pad(dout, ((0, 0), (0, Tqp - tq), (0, 0)))
@@ -694,5 +699,7 @@ def varlen_flash_attention_fwd(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
     qt = jnp.swapaxes(q, 0, 1)  # [H, T, D]
     kt = jnp.swapaxes(k, 0, 1)
     vt = jnp.swapaxes(v, 0, 1)
-    out = _varlen(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, causal, scale)
+    bq, bk = _block_sizes(Tq, Tk, d=qt.shape[-1])
+    out = _varlen(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, causal, scale,
+                  bq, bk)
     return jnp.swapaxes(out, 0, 1)
