@@ -309,6 +309,17 @@ def main():
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     cfg_name = os.environ.get("BENCH_CONFIG")
     matrix = os.environ.get("BENCH_MATRIX")
+    if os.environ.get("BENCH_NO_PALLAS"):
+        # model-level A/B: force the XLA-composite attention instead of the
+        # Pallas kernels (perf attribution on hardware). importlib, because
+        # both `from ... import` AND `import ... as` resolve through the
+        # package attribute, which the star-import rebound to the
+        # same-named FUNCTION.
+        import importlib
+
+        _fa_mod = importlib.import_module(
+            "paddle_tpu.nn.functional.flash_attention")
+        _fa_mod._USE_PALLAS = False
 
     if matrix:
         import paddle_tpu.distributed as dist

@@ -40,6 +40,12 @@ log "5. autotuned rerun (block-size search on chip)"
 PADDLE_TPU_AUTOTUNE=1 BENCH_CONFIG=gpt3_125m timeout 2400 python bench.py \
   | tee "$OUT/bench_125m_autotuned.json"
 
+log "5b. A/B: XLA-composite attention + round-2 128-block tiling"
+BENCH_NO_PALLAS=1 BENCH_CONFIG=gpt3_125m timeout 1800 python bench.py \
+  | tee "$OUT/bench_125m_no_pallas.json"
+PADDLE_TPU_FLASH_BLOCK=128 BENCH_CONFIG=gpt3_125m timeout 1800 python bench.py \
+  | tee "$OUT/bench_125m_block128.json"
+
 log "6. trace for the judge (BENCH_TRACE_DIR)"
 BENCH_TRACE_DIR="$OUT/trace" BENCH_CONFIG=gpt3_125m timeout 1800 python bench.py \
   | tee "$OUT/bench_125m_traced.json"
