@@ -1,4 +1,4 @@
-from . import activation, common, container, conv, layers, loss, norm, pooling, transformer
+from . import activation, common, container, conv, layers, loss, norm, pooling, rnn, transformer
 from .activation import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .container import *  # noqa: F401,F403
@@ -7,4 +7,5 @@ from .layers import Layer, ParamAttr  # noqa: F401
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from .transformer import *  # noqa: F401,F403

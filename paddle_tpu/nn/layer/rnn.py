@@ -1,0 +1,800 @@
+"""Recurrent layers: cells, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU.
+
+Reference surface: python/paddle/nn/layer/rnn.py (SimpleRNNCell:742,
+LSTMCell:919, GRUCell:1145, RNN:1340, BiRNN:1422, RNNBase:1515,
+SimpleRNN:1860, LSTM:1983, GRU:2120) and the rnn()/birnn() functionals
+(rnn.py:64,388).
+
+TPU-first design — this is NOT the reference's architecture:
+
+* The reference runs either a per-step Python loop (dygraph) or a cuDNN
+  monolith kernel (rnn_kernel.cu.cc). Here the whole time loop of one
+  (layer, direction) is a SINGLE op on the autograd tape: a
+  ``jax.lax.scan`` inside one ``run_op`` call. XLA compiles the scan once,
+  keeps the carried state in registers/VMEM, and the MXU sees one big
+  batched matmul per gate per step; the backward pass is ``jax.vjp``
+  through the scan (which XLA turns into a reverse scan with
+  checkpointing) — no cuDNN analog needed, no T tape nodes.
+* Sequence-length masking is fused into the scan body (state carry-over via
+  ``mask*new + (1-mask)*old``, the reference's _maybe_copy at rnn.py:163).
+* Arbitrary user cells work too: their eager ``forward`` is traced into the
+  scan body via the module-state swap (the same mechanism as
+  jit.functional_call). Cells whose Python control flow cannot be traced
+  fall back to the reference's eager per-step loop.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op, tracing_guard, in_tracing
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer, ParamAttr
+from .container import LayerList
+
+__all__ = [
+    "RNNCellBase",
+    "SimpleRNNCell",
+    "LSTMCell",
+    "GRUCell",
+    "RNN",
+    "BiRNN",
+    "SimpleRNN",
+    "LSTM",
+    "GRU",
+]
+# rnn()/birnn() are public too (reference rnn.py:64,388) but kept out of
+# __all__ so the star-import doesn't shadow this module's name in the
+# package namespace; import them as paddle.nn.layer.rnn.rnn / .birnn.
+
+
+# --------------------------------------------------------------------------- #
+# state pytree helpers (reference rnn.py:488 split_states / :545 concat_states)
+# --------------------------------------------------------------------------- #
+
+def split_states(states, bidirectional=False, state_components=1):
+    """Split stacked [L*D, B, H] states into per-layer (per-direction) nests."""
+    if state_components == 1:
+        states = [states] if isinstance(states, Tensor) else list(states)
+        states = states[0]
+        # states: [L*D, B, H]
+        layers = [states[i] for i in range(states.shape[0])]
+        if not bidirectional:
+            return layers
+        return [(layers[2 * i], layers[2 * i + 1]) for i in range(len(layers) // 2)]
+    else:
+        components = [
+            [comp[i] for i in range(comp.shape[0])] for comp in states
+        ]
+        per_slot = list(zip(*components))  # [(h_i, c_i), ...]
+        if not bidirectional:
+            return [tuple(s) for s in per_slot]
+        return [
+            (tuple(per_slot[2 * i]), tuple(per_slot[2 * i + 1]))
+            for i in range(len(per_slot) // 2)
+        ]
+
+
+def concat_states(states, bidirectional=False, state_components=1):
+    """Inverse of split_states: stack per-layer states back to [L*D, B, H]."""
+    from ...tensor import stack  # local import to avoid cycles
+
+    if bidirectional:
+        flat_slots = []
+        for s in states:
+            flat_slots.extend([s[0], s[1]])
+    else:
+        flat_slots = list(states)
+    if state_components == 1:
+        return stack(flat_slots, axis=0)
+    comps = []
+    for c in range(state_components):
+        comps.append(stack([slot[c] for slot in flat_slots], axis=0))
+    return tuple(comps)
+
+
+def _flatten_states(states):
+    """Flatten a nest of Tensors to (leaves, treedef) with Tensor leaves."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        states, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    return leaves, treedef
+
+
+# --------------------------------------------------------------------------- #
+# cells
+# --------------------------------------------------------------------------- #
+
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells (reference rnn.py:591)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        if shape is None:
+            shape = self.state_shape
+        if dtype is None:
+            dtype = batch_ref.dtype if hasattr(batch_ref, "dtype") else "float32"
+        ref_leaves, _ = _flatten_states(batch_ref)
+        batch = ref_leaves[0].shape[batch_dim_idx]
+
+        def build(s):
+            full = (batch,) + tuple(int(d) for d in s)
+            return Tensor(jnp.full(full, init_value, jnp.dtype(str(dtype))),
+                          stop_gradient=True)
+
+        return _map_state_shape(build, shape)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} must define state_shape")
+
+
+def _is_shape(s):
+    return isinstance(s, (list, tuple)) and all(
+        isinstance(d, int) for d in s)
+
+
+def _map_state_shape(fn, shape):
+    """Map fn over a nest whose leaves are shape tuples (tuples of ints)."""
+    if _is_shape(shape):
+        return fn(shape)
+    return tuple(_map_state_shape(fn, s) for s in shape)
+
+
+def _uniform_or(flag_attr, layer, shape, std, is_bias=False, const=0.0):
+    """create_parameter with Uniform(-std, std) default; attr False =>
+    constant non-trainable (reference SimpleRNNCell.__init__ pattern)."""
+    if flag_attr is not False:
+        return layer.create_parameter(
+            shape, attr=flag_attr, is_bias=is_bias,
+            default_initializer=I.Uniform(-std, std))
+    p = layer.create_parameter(
+        shape, attr=None, is_bias=is_bias,
+        default_initializer=I.Constant(const))
+    p.stop_gradient = True
+    return p
+
+
+class SimpleRNNCell(RNNCellBase):
+    r"""h_t = act(W_ih x_t + b_ih + W_hh h_{t-1} + b_hh)
+    (reference rnn.py:742)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError(
+                f"hidden_size of {type(self).__name__} must be greater "
+                f"than 0, but now equals to {hidden_size}")
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = _uniform_or(weight_ih_attr, self,
+                                     (hidden_size, input_size), std, const=1.0)
+        self.weight_hh = _uniform_or(weight_hh_attr, self,
+                                     (hidden_size, hidden_size), std, const=1.0)
+        self.bias_ih = _uniform_or(bias_ih_attr, self,
+                                   (hidden_size,), std, is_bias=True)
+        self.bias_hh = _uniform_or(bias_hh_attr, self,
+                                   (hidden_size,), std, is_bias=True)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                "activation for SimpleRNNCell should be tanh or relu, "
+                f"but get {activation}")
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h = states
+        i2h = inputs.matmul(self.weight_ih, transpose_y=True) + self.bias_ih
+        h2h = pre_h.matmul(self.weight_hh, transpose_y=True) + self.bias_hh
+        h = (i2h + h2h).tanh() if self.activation == "tanh" else F.relu(i2h + h2h)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.activation != "tanh":
+            s += f", activation={self.activation}"
+        return s
+
+
+class LSTMCell(RNNCellBase):
+    r"""Fused-gate LSTM cell; gate order i, f, g, o in the packed weights
+    (reference rnn.py:919; proj_size per LSTMP)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError(
+                f"hidden_size of {type(self).__name__} must be greater "
+                f"than 0, but now equals to {hidden_size}")
+        proj_size = proj_size or 0
+        if proj_size >= hidden_size:
+            raise ValueError("proj_size must be smaller than hidden_size")
+        std = 1.0 / math.sqrt(hidden_size)
+        h_in = proj_size if proj_size > 0 else hidden_size
+        self.weight_ih = _uniform_or(weight_ih_attr, self,
+                                     (4 * hidden_size, input_size), std,
+                                     const=1.0)
+        self.weight_hh = _uniform_or(weight_hh_attr, self,
+                                     (4 * hidden_size, h_in), std, const=1.0)
+        self.bias_ih = _uniform_or(bias_ih_attr, self,
+                                   (4 * hidden_size,), std, is_bias=True)
+        self.bias_hh = _uniform_or(bias_hh_attr, self,
+                                   (4 * hidden_size,), std, is_bias=True)
+        self.proj_size = proj_size
+        if proj_size > 0:
+            self.weight_ho = _uniform_or(weight_hh_attr, self,
+                                         (hidden_size, proj_size), std,
+                                         const=1.0)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_hidden, pre_cell = states
+        gates = inputs.matmul(self.weight_ih, transpose_y=True) + self.bias_ih
+        gates = gates + pre_hidden.matmul(self.weight_hh, transpose_y=True) \
+            + self.bias_hh
+        from ...tensor import split as _split
+        ig, fg, gg, og = _split(gates, 4, axis=-1)
+        i = F.sigmoid(ig)
+        f = F.sigmoid(fg)
+        o = F.sigmoid(og)
+        c = f * pre_cell + i * gg.tanh()
+        h = o * c.tanh()
+        if self.proj_size > 0:
+            h = h.matmul(self.weight_ho)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.proj_size or self.hidden_size,), (self.hidden_size,))
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    r"""GRU cell, reset gate applied after the hidden matmul; gate order
+    r, z, c (reference rnn.py:1145)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError(
+                f"hidden_size of {type(self).__name__} must be greater "
+                f"than 0, but now equals to {hidden_size}")
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = _uniform_or(weight_ih_attr, self,
+                                     (3 * hidden_size, input_size), std,
+                                     const=1.0)
+        self.weight_hh = _uniform_or(weight_hh_attr, self,
+                                     (3 * hidden_size, hidden_size), std,
+                                     const=1.0)
+        self.bias_ih = _uniform_or(bias_ih_attr, self,
+                                   (3 * hidden_size,), std, is_bias=True)
+        self.bias_hh = _uniform_or(bias_hh_attr, self,
+                                   (3 * hidden_size,), std, is_bias=True)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_hidden = states
+        x_gates = inputs.matmul(self.weight_ih, transpose_y=True) + self.bias_ih
+        h_gates = pre_hidden.matmul(self.weight_hh, transpose_y=True) \
+            + self.bias_hh
+        from ...tensor import split as _split
+        x_r, x_z, x_c = _split(x_gates, 3, axis=-1)
+        h_r, h_z, h_c = _split(h_gates, 3, axis=-1)
+        r = F.sigmoid(x_r + h_r)
+        z = F.sigmoid(x_z + h_z)
+        c = (x_c + r * h_c).tanh()
+        h = (pre_hidden - c) * z + c
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+# --------------------------------------------------------------------------- #
+# the fused scan (one run_op per (layer, direction) — see module docstring)
+# --------------------------------------------------------------------------- #
+#
+# Two paths share the same "one scan = one tape op" shape:
+#  * builtin cells — pure module-level step functions; the run_op closure
+#    holds only strs/ints/bools so the dispatch cache can key it by value
+#    (framework/core.py _fn_token) and the scan compiles ONCE per shape.
+#  * custom cells — the cell's eager forward is traced into the scan body
+#    via the module-state swap. The closure holds the live cell, which is
+#    uncacheable: correct, but retraced per call.
+
+def _sig(x):
+    return jax.nn.sigmoid(x)
+
+
+def _simple_step(act_relu, xt, h, w_ih, w_hh, b_ih, b_hh):
+    z = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jax.nn.relu(z) if act_relu else jnp.tanh(z)
+
+
+def _lstm_step(xt, h, c, w_ih, w_hh, b_ih, b_hh, w_ho=None):
+    gates = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c2 = _sig(f) * c + _sig(i) * jnp.tanh(g)
+    h2 = _sig(o) * jnp.tanh(c2)
+    if w_ho is not None:
+        h2 = h2 @ w_ho
+    return h2, c2
+
+
+def _gru_step(xt, h, w_ih, w_hh, b_ih, b_hh):
+    xg = xt @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = _sig(x_r + h_r)
+    z = _sig(x_z + h_z)
+    c = jnp.tanh(x_c + r * h_c)
+    return (h - c) * z + c
+
+
+def _mask_merge(mt, new, old):
+    m = mt.reshape(mt.shape + (1,) * (new.ndim - 1))
+    return m * new + (1 - m) * old
+
+
+def _builtin_spec(cell):
+    """(kind, params, act_relu) for unmodified builtin cells."""
+    t = type(cell)
+    if t is SimpleRNNCell:
+        return ("simple",
+                [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh],
+                cell.activation == "relu")
+    if t is LSTMCell:
+        ps = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+        if cell.proj_size > 0:
+            ps.append(cell.weight_ho)
+        return ("lstm", ps, False)
+    if t is GRUCell:
+        return ("gru",
+                [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh],
+                False)
+    return None
+
+
+def _scan_rnn(cell, inputs, initial_states, sequence_length, time_major,
+              is_reverse, kwargs):
+    """One lax.scan over time as a single tape op. Raises jax trace errors
+    for cells with untraceable Python control flow (caller falls back)."""
+    state_leaves, treedef = _flatten_states(initial_states)
+    n_s = len(state_leaves)
+    has_mask = sequence_length is not None
+    spec = _builtin_spec(cell) if not kwargs else None
+
+    if spec is not None:
+        kind, param_tensors, act_relu = spec
+
+        def fn(x, *rest):
+            states0 = rest[:n_s]
+            seq = rest[n_s] if has_mask else None
+            pvals = rest[n_s + 1:] if has_mask else rest[n_s:]
+            xs = x if time_major else jnp.moveaxis(x, 1, 0)  # [T, B, I]
+            T = xs.shape[0]
+            if has_mask:
+                m = (jnp.arange(T)[None, :] < seq[:, None]).astype(xs.dtype)
+                scan_xs = (xs, jnp.moveaxis(m, 1, 0))
+            else:
+                scan_xs = (xs,)
+
+            def step(carry, xt_m):
+                xt = xt_m[0]
+                if kind == "simple":
+                    h = _simple_step(act_relu, xt, carry[0], *pvals)
+                    new = (h,)
+                elif kind == "lstm":
+                    h, c = _lstm_step(xt, carry[0], carry[1], *pvals)
+                    new = (h, c)
+                else:
+                    h = _gru_step(xt, carry[0], *pvals)
+                    new = (h,)
+                out = new[0]  # step outputs stay unmasked (reference
+                # rnn.py:176 only _maybe_copy's the STATES); mask gates
+                # the carry so padded steps don't advance the state.
+                if has_mask:
+                    mt = xt_m[1]
+                    new = tuple(_mask_merge(mt, n, o)
+                                for n, o in zip(new, carry))
+                return new, out
+
+            final, ys = jax.lax.scan(step, tuple(states0), scan_xs,
+                                     reverse=is_reverse)
+            ys = ys if time_major else jnp.moveaxis(ys, 0, 1)
+            return (ys,) + tuple(final)
+    else:
+        from ...jit import _ModuleState  # lazy: jit imports nn at module load
+
+        state = _ModuleState(cell)
+        param_items = sorted(state.params.items())
+        param_names = [k for k, _ in param_items]
+        param_tensors = [p for _, p in param_items]
+        out_tree = []  # output treedef, captured at trace time; this path
+        # is never dispatch-cached (closure holds the live cell), so fn —
+        # and the capture — runs on every call.
+
+        def fn(x, *rest):
+            states0 = rest[:n_s]
+            seq = rest[n_s] if has_mask else None
+            pvals = rest[n_s + 1:] if has_mask else rest[n_s:]
+            xs = x if time_major else jnp.moveaxis(x, 1, 0)  # [T, B, ...]
+            T = xs.shape[0]
+            if has_mask:
+                m = (jnp.arange(T)[None, :] < seq[:, None]).astype(xs.dtype)
+                scan_xs = (xs, jnp.moveaxis(m, 1, 0))
+            else:
+                scan_xs = (xs,)
+
+            saved = state.swap_in(dict(zip(param_names, pvals)), None)
+            try:
+                def step(carry, xt_m):
+                    xt = xt_m[0]
+                    st = jax.tree_util.tree_unflatten(
+                        treedef, [Tensor(c) for c in carry])
+                    with tracing_guard(True):
+                        out, new_st = cell(Tensor(xt), st, **kwargs)
+                    new_leaves = [
+                        t._value for t in _flatten_states(new_st)[0]]
+                    if has_mask:
+                        mt = xt_m[1]
+                        new_leaves = [_mask_merge(mt, n, o)
+                                      for n, o in zip(new_leaves, carry)]
+                    o_leaves, o_tree = _flatten_states(out)
+                    if not out_tree:
+                        out_tree.append(o_tree)
+                    return tuple(new_leaves), tuple(
+                        t._value for t in o_leaves)
+
+                final, ys = jax.lax.scan(step, tuple(states0), scan_xs,
+                                         reverse=is_reverse)
+            finally:
+                state.restore(saved)
+            ys = [y if time_major else jnp.moveaxis(y, 0, 1) for y in ys]
+            return tuple(ys) + tuple(final)
+
+    op_inputs = [inputs] + list(state_leaves)
+    if has_mask:
+        op_inputs.append(sequence_length)
+    op_inputs.extend(param_tensors)
+    out = run_op("rnn_scan", fn, op_inputs)
+    out = list(out) if isinstance(out, tuple) else [out]
+    n_out = len(out) - n_s
+    out_leaves, final_leaves = out[:n_out], out[n_out:]
+    # outputs mirror the structure of one step's output; builtin cells (and
+    # any cell returning a single Tensor) yield a Tensor, custom cells with
+    # nested outputs get their structure back from the trace-time capture.
+    if n_out == 1:
+        outputs = out_leaves[0]
+    elif spec is None and out_tree:
+        outputs = jax.tree_util.tree_unflatten(out_tree[0], out_leaves)
+    else:
+        outputs = tuple(out_leaves)
+    final_states = jax.tree_util.tree_unflatten(treedef, final_leaves)
+    return outputs, final_states
+
+
+def _rnn_eager_loop(cell, inputs, initial_states, sequence_length,
+                    time_major, is_reverse, kwargs):
+    """Reference dygraph path (rnn.py:176): per-step Python loop. Used only
+    when the cell cannot be traced into the fused scan."""
+    from ...tensor import stack
+
+    time_axis = 0 if time_major else 1
+    T = inputs.shape[time_axis]
+    states = initial_states
+    mask = None
+    if sequence_length is not None:
+        ar = jnp.arange(T)[None, :] < sequence_length._value[:, None]
+        mask = ar.astype(inputs._value.dtype)  # [B, T]
+
+    order = range(T - 1, -1, -1) if is_reverse else range(T)
+    outputs = []
+    for i in order:
+        xt = inputs[:, i] if not time_major else inputs[i]
+        out, new_states = cell(xt, states, **kwargs)
+        if mask is not None:
+            mt = Tensor(mask[:, i])
+            sl, td = _flatten_states(new_states)
+            ol, _ = _flatten_states(states)
+            merged = []
+            for n, o in zip(sl, ol):
+                m = mt.reshape([-1] + [1] * (len(n.shape) - 1))
+                merged.append(m * n + (1.0 - m) * o)
+            new_states = jax.tree_util.tree_unflatten(td, merged)
+            if td.num_leaves == 1 and isinstance(new_states, (tuple, list)):
+                new_states = new_states[0]
+        states = new_states
+        outputs.append(out)
+    if is_reverse:
+        outputs = outputs[::-1]
+    outputs = stack(outputs, axis=time_axis)
+    return outputs, states
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run a cell over the time dimension (reference rnn.py:64).
+
+    inputs: [B, T, ...] (or [T, B, ...] when time_major). Returns
+    (outputs, final_states).
+    """
+    if initial_states is None:
+        initial_states = cell.get_initial_states(
+            inputs, batch_dim_idx=1 if time_major else 0)
+    if in_tracing():
+        # already inside a jax trace: run the loop inline (it will be part
+        # of the enclosing jit program).
+        return _rnn_eager_loop(cell, inputs, initial_states, sequence_length,
+                               time_major, is_reverse, kwargs)
+    try:
+        return _scan_rnn(cell, inputs, initial_states, sequence_length,
+                         time_major, is_reverse, kwargs)
+    except Exception as e:  # noqa: BLE001 — trace-ineligible cells only
+        from ...jit import _is_trace_ineligible
+        if not _is_trace_ineligible(e):
+            raise
+        return _rnn_eager_loop(cell, inputs, initial_states, sequence_length,
+                               time_major, is_reverse, kwargs)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional pass: two fused scans, outputs concat on the feature
+    axis (reference rnn.py:388)."""
+    from ...tensor import concat
+
+    if initial_states is None:
+        states_fw = None
+        states_bw = None
+    else:
+        states_fw, states_bw = initial_states
+    out_fw, st_fw = rnn(cell_fw, inputs, states_fw, sequence_length,
+                        time_major, False, **kwargs)
+    out_bw, st_bw = rnn(cell_bw, inputs, states_bw, sequence_length,
+                        time_major, True, **kwargs)
+    outputs = concat([out_fw, out_bw], axis=-1)
+    return outputs, (st_fw, st_bw)
+
+
+# --------------------------------------------------------------------------- #
+# layer wrappers
+# --------------------------------------------------------------------------- #
+
+class RNN(Layer):
+    """Wrap a cell into a sequence layer (reference rnn.py:1340)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        if not hasattr(self.cell, "call") and not hasattr(self.cell, "forward"):
+            raise ValueError("RNN cell must define forward")
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        return rnn(self.cell, inputs, initial_states, sequence_length,
+                   self.time_major, self.is_reverse, **kwargs)
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over one sequence (reference rnn.py:1422)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        if cell_fw.input_size != cell_bw.input_size:
+            raise ValueError(
+                "input size of forward and backward cells must match")
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if isinstance(initial_states, (list, tuple)) \
+                and len(initial_states) != 2:
+            raise ValueError("initial_states must be a pair (fw, bw)")
+        return birnn(self.cell_fw, self.cell_bw, inputs, initial_states,
+                     sequence_length, self.time_major, **kwargs)
+
+
+class RNNBase(LayerList):
+    """Multi-layer (optionally bidirectional) recurrent net
+    (reference rnn.py:1515). One fused scan per (layer, direction); dropout
+    between layers; stacked [L*D, B, H] state interface."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0):
+        super().__init__()
+        bidirectional_list = ("bidirectional", "bidirect")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        self.num_directions = 2 if direction in bidirectional_list else 1
+        self.time_major = time_major
+        self.num_layers = num_layers
+        self.state_components = 2 if mode == "LSTM" else 1
+        self.proj_size = proj_size or 0
+        if self.proj_size > 0 and mode != "LSTM":
+            raise ValueError("proj_size only supported for LSTM")
+
+        kwargs = {
+            "weight_ih_attr": weight_ih_attr,
+            "weight_hh_attr": weight_hh_attr,
+            "bias_ih_attr": bias_ih_attr,
+            "bias_hh_attr": bias_hh_attr,
+        }
+        if mode == "LSTM":
+            rnn_cls = LSTMCell
+            kwargs["proj_size"] = proj_size
+        elif mode == "GRU":
+            rnn_cls = GRUCell
+        elif mode == "RNN_RELU":
+            rnn_cls = SimpleRNNCell
+            kwargs["activation"] = "relu"
+        elif mode == "RNN_TANH":
+            rnn_cls = SimpleRNNCell
+            kwargs["activation"] = "tanh"
+        else:
+            raise ValueError(f"unknown RNN mode {mode!r}")
+
+        in_size = self.proj_size or hidden_size
+        if direction == "forward":
+            cell = rnn_cls(input_size, hidden_size, **kwargs)
+            self.append(RNN(cell, False, time_major))
+            for _ in range(1, num_layers):
+                cell = rnn_cls(in_size, hidden_size, **kwargs)
+                self.append(RNN(cell, False, time_major))
+        elif direction in bidirectional_list:
+            cell_fw = rnn_cls(input_size, hidden_size, **kwargs)
+            cell_bw = rnn_cls(input_size, hidden_size, **kwargs)
+            self.append(BiRNN(cell_fw, cell_bw, time_major))
+            for _ in range(1, num_layers):
+                cell_fw = rnn_cls(2 * in_size, hidden_size, **kwargs)
+                cell_bw = rnn_cls(2 * in_size, hidden_size, **kwargs)
+                self.append(BiRNN(cell_fw, cell_bw, time_major))
+        else:
+            raise ValueError(
+                "direction should be forward or bidirect (or bidirectional), "
+                f"received direction = {direction}")
+
+        # Expose paddle-style flat aliases (weight_ih_l0, ... , *_reverse) so
+        # user code that pokes at them keeps working. Set via object.__setattr__
+        # on purpose: state_dict keys stay the structural "0.cell.weight_ih"
+        # form (no duplicate entries), matching this framework's checkpoints.
+        for layer_i in range(num_layers):
+            sub = self[layer_i]
+            cells = [sub.cell] if self.num_directions == 1 \
+                else [sub.cell_fw, sub.cell_bw]
+            for d, c in enumerate(cells):
+                suffix = "_reverse" if d == 1 else ""
+                object.__setattr__(
+                    self, f"weight_ih_l{layer_i}{suffix}", c.weight_ih)
+                object.__setattr__(
+                    self, f"weight_hh_l{layer_i}{suffix}", c.weight_hh)
+                if bias_ih_attr is not False:
+                    object.__setattr__(
+                        self, f"bias_ih_l{layer_i}{suffix}", c.bias_ih)
+                if bias_hh_attr is not False:
+                    object.__setattr__(
+                        self, f"bias_hh_l{layer_i}{suffix}", c.bias_hh)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_index = 1 if self.time_major else 0
+        dtype = inputs.dtype
+        if initial_states is None:
+            batch = inputs.shape[batch_index]
+            dims = ([self.proj_size or self.hidden_size], [self.hidden_size])
+            initial_states = tuple(
+                Tensor(jnp.zeros(
+                    (self.num_layers * self.num_directions, batch, *dims[i]),
+                    jnp.dtype(str(dtype))), stop_gradient=True)
+                for i in range(self.state_components))
+        elif isinstance(initial_states, Tensor):
+            initial_states = [initial_states]
+
+        states = split_states(initial_states, self.num_directions == 2,
+                              self.state_components)
+        final_states = []
+        outputs = inputs
+        for i, rnn_layer in enumerate(self):
+            if i > 0:
+                outputs = F.dropout(outputs, self.dropout,
+                                    training=self.training,
+                                    mode="upscale_in_train")
+            outputs, final_state = rnn_layer(outputs, states[i],
+                                             sequence_length)
+            final_states.append(final_state)
+
+        final_states = concat_states(final_states, self.num_directions == 2,
+                                     self.state_components)
+        return outputs, final_states
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.num_layers != 1:
+            s += f", num_layers={self.num_layers}"
+        if self.time_major:
+            s += f", time_major={self.time_major}"
+        if self.dropout:
+            s += f", dropout={self.dropout}"
+        return s
+
+
+class SimpleRNN(RNNBase):
+    """Multi-layer Elman RNN (reference rnn.py:1860)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        if activation == "tanh":
+            mode = "RNN_TANH"
+        elif activation == "relu":
+            mode = "RNN_RELU"
+        else:
+            raise ValueError(f"Unknown activation '{activation}'")
+        self.activation = activation
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(RNNBase):
+    """Multi-layer LSTM (reference rnn.py:1983)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0,
+                 name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr,
+                         proj_size)
+
+
+class GRU(RNNBase):
+    """Multi-layer GRU (reference rnn.py:2120)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
