@@ -122,3 +122,5 @@ from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import onnx  # noqa: E402
 from . import signal  # noqa: E402
+from . import geometric  # noqa: E402
+from . import _C_ops  # noqa: E402  (kernel-level op surface, reference paddle._C_ops)

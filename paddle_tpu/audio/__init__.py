@@ -11,3 +11,5 @@ from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]
+
+from . import datasets  # noqa: E402

@@ -1,0 +1,120 @@
+"""Local-file audio dataset loaders (reference: python/paddle/audio/
+datasets/tess.py, esc50.py — download zoos; here the same on-disk layouts
+read from user paths, zero-egress)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _read_wav(path):
+    from scipy.io import wavfile
+
+    sr, data = wavfile.read(path)
+    if data.dtype.kind == "i":
+        data = data.astype(np.float32) / np.iinfo(data.dtype).max
+    elif data.dtype.kind == "u":
+        data = (data.astype(np.float32) - 128.0) / 128.0
+    else:
+        data = data.astype(np.float32)
+    if data.ndim > 1:
+        data = data.mean(axis=1)
+    return data, sr
+
+
+class TESS(Dataset):
+    """Toronto emotional speech set (reference audio/datasets/tess.py).
+    Reads `<root>/**/<anything>_<word>_<emotion>.wav`; labels are the seven
+    emotions in the reference's ordering."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, data_dir=None, mode="train", n_folds=5,
+                 split=1, feat_type="raw", download=False, **kw):
+        if download or data_dir is None:
+            raise RuntimeError(
+                "downloads unavailable (zero-egress); pass data_dir")
+        self.feat_type = feat_type
+        self.feat_kw = kw
+        files = []
+        for base, _dirs, names in os.walk(data_dir):
+            for n in sorted(names):
+                if n.lower().endswith(".wav"):
+                    emo = n.rsplit(".", 1)[0].rsplit("_", 1)[-1].lower()
+                    if emo in self.EMOTIONS:
+                        files.append((os.path.join(base, n),
+                                      self.EMOTIONS.index(emo)))
+        fold_of = lambda i: i % n_folds + 1  # noqa: E731
+        if mode == "train":
+            self.files = [f for i, f in enumerate(files)
+                          if fold_of(i) != split]
+        else:
+            self.files = [f for i, f in enumerate(files)
+                          if fold_of(i) == split]
+
+    def _features(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav
+        from . import features as AF
+        from ..framework.core import to_tensor
+
+        layer = {"melspectrogram": AF.MelSpectrogram,
+                 "mfcc": AF.MFCC,
+                 "logmelspectrogram": AF.LogMelSpectrogram,
+                 "spectrogram": AF.Spectrogram}[self.feat_type](
+            sr=sr, **self.feat_kw)
+        return np.asarray(layer(to_tensor(wav[None])).numpy())[0]
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        path, label = self.files[idx]
+        wav, sr = _read_wav(path)
+        return self._features(wav, sr), np.int64(label)
+
+
+class ESC50(Dataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py).
+    Reads the standard layout `<root>/audio/<fold>-*-<target>.csv|wav` via
+    `<root>/meta/esc50.csv`."""
+
+    def __init__(self, data_dir=None, mode="train", split=1,
+                 feat_type="raw", download=False, **kw):
+        if download or data_dir is None:
+            raise RuntimeError(
+                "downloads unavailable (zero-egress); pass data_dir")
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        audio_dir = os.path.join(data_dir, "audio")
+        self.feat_type = feat_type
+        self.feat_kw = kw
+        rows = []
+        with open(meta) as f:
+            header = f.readline().strip().split(",")
+            fi = header.index("filename")
+            fo = header.index("fold")
+            tg = header.index("target")
+            for ln in f:
+                c = ln.strip().split(",")
+                rows.append((c[fi], int(c[fo]), int(c[tg])))
+        if mode == "train":
+            keep = [(fn, t) for fn, fold, t in rows if fold != split]
+        else:
+            keep = [(fn, t) for fn, fold, t in rows if fold == split]
+        self.files = [(os.path.join(audio_dir, fn), t) for fn, t in keep]
+
+    _features = TESS._features
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        path, label = self.files[idx]
+        wav, sr = _read_wav(path)
+        return self._features(wav, sr), np.int64(label)

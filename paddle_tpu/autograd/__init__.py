@@ -49,6 +49,98 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         _backward_impl(t, g, retain_graph=True)
 
 
+def _grad_create_graph(outs, ins, gouts, allow_unused):
+    """Differentiable grads: replay the recorded forward subgraph as a pure
+    jax function of the inputs and take jax.vjp THROUGH one run_op, so the
+    returned grads carry their own tape nodes (grad-of-grad works exactly
+    like the reference's double_grad ops,
+    test/legacy_test/test_imperative_double_grad.py).
+
+    The in-place tape walk (framework.core.backward) computes raw values —
+    it cannot record itself; this functional path is the TPU-native
+    equivalent of the reference's generated higher-order GradNodes."""
+    # collect the forward subgraph
+    nodes = {}
+    stack = [o._grad_node for o in outs if o._grad_node is not None]
+    if not stack:
+        raise RuntimeError("create_graph=True requires outputs on the tape")
+    while stack:
+        node = stack.pop()
+        if node.id in nodes:
+            continue
+        nodes[node.id] = node
+        if node.fwd_fn is None:
+            raise RuntimeError(
+                f"op '{node.name}' recorded no forward fn; cannot build a "
+                "differentiable grad graph through it")
+        for t in node.inputs:
+            if t._grad_node is not None and t._grad_node.id not in nodes:
+                stack.append(t._grad_node)
+    order = sorted(nodes)  # ascending creation id = forward order
+
+    produced_ids = set()
+    for node in nodes.values():
+        for wref in node.weak_outputs:
+            t = wref()
+            if t is not None:
+                produced_ids.add(id(t))
+    in_ids = {id(t) for t in ins}
+    # leaves: subgraph inputs not produced inside it and not differentiated
+    leaves, seen = [], set()
+    for nid in order:
+        for t in nodes[nid].inputs:
+            if (id(t) not in produced_ids and id(t) not in in_ids
+                    and id(t) not in seen):
+                leaves.append(t)
+                seen.add(id(t))
+    connected = {id(t) for n in nodes.values() for t in n.inputs}
+    connected |= produced_ids
+    for t in ins:
+        if id(t) not in connected and not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears unused; "
+                "pass allow_unused=True to return None for it")
+
+    nb, ni = len(leaves), len(ins)
+    node_list = [nodes[nid] for nid in order]
+
+    def fn(*vals):
+        base_vals = vals[:nb]
+        in_vals = vals[nb:nb + ni]
+        gout_vals = vals[nb + ni:]
+
+        def inner(iv):
+            env = {id(t): v for t, v in zip(leaves, base_vals)}
+            for t, v in zip(ins, iv):
+                env[id(t)] = v
+            for node in node_list:
+                ivals = [env[id(t)] for t in node.inputs]
+                res = node.fwd_fn(*ivals)
+                rl = res if isinstance(res, tuple) else (res,)
+                for i, wref in enumerate(node.weak_outputs):
+                    t = wref()
+                    # injected ins keep their independent value even when
+                    # re-produced (grad w.r.t. an intermediate holds its
+                    # producer fixed)
+                    if t is not None and id(t) not in in_ids:
+                        env[id(t)] = rl[i]
+            return tuple(env[id(o)] for o in outs)
+
+        _, vjp_fn = jax.vjp(inner, tuple(in_vals))
+        (gs,) = vjp_fn(tuple(gout_vals))
+        # the tape normalizes single outputs to a bare value (run_op's
+        # 1-tuple and scalar paths must agree for the second backward)
+        return tuple(gs) if len(gs) > 1 else gs[0]
+
+    gout_tensors = [
+        g if g is not None else Tensor(jnp.ones_like(o._value))
+        for o, g in zip(outs, gouts)]
+    res = run_op("grad_replay", fn, list(leaves) + list(ins) + gout_tensors)
+    res = list(res) if isinstance(res, tuple) else [res]
+    return [r if id(t) in connected else None
+            for t, r in zip(ins, res)]
+
+
 def grad(
     outputs,
     inputs,
@@ -65,6 +157,14 @@ def grad(
     outs = [outputs] if single_out else list(outputs)
     single_in = isinstance(inputs, Tensor)
     ins = [inputs] if single_in else list(inputs)
+    if create_graph:
+        gouts_n = grad_outputs
+        if gouts_n is None:
+            gouts_n = [None] * len(outs)
+        elif isinstance(gouts_n, Tensor):
+            gouts_n = [gouts_n]
+        results = _grad_create_graph(outs, ins, gouts_n, allow_unused)
+        return results[0] if single_in else results
     saved = [(t.grad, t.stop_gradient, t._retain_grads) for t in ins]
     for t in ins:
         t.grad = None

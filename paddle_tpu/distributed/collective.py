@@ -127,6 +127,18 @@ def new_group(ranks=None, backend=None, timeout=None) -> Group:
 
 def destroy_process_group(group=None):
     global _default_group
+    # sweep this rank's residual store keys for the group's communicators
+    # (bounded leak otherwise — see eager_multiproc.cleanup_group_keys)
+    from . import eager_multiproc as mp
+
+    if mp.nprocs() > 1 and mp._group_seq:
+        from .store import create_or_get_global_tcp_store
+
+        try:
+            mp.cleanup_group_keys(create_or_get_global_tcp_store(),
+                                  gid=None if group is None else group.id)
+        except Exception:
+            pass
     if group is None:
         _groups.clear()
         _default_group = None

@@ -78,6 +78,24 @@ def allreduce_value(v, op="sum"):
 _group_seq: dict = {}
 
 
+def cleanup_group_keys(store, gid=None):
+    """Delete this rank's residual gar/ keys (the last two rounds per tag
+    are kept live by the rolling cleanup in store_allreduce_group; without
+    this, communicators used once or twice leak keys for the job's life).
+    Called on group destroy / shutdown; gid=None sweeps every tag."""
+    me = rank()
+    for tag, seq in list(_group_seq.items()):
+        if gid is not None and not tag.endswith(f"#g{gid}"):
+            continue
+        for s in (seq - 1, seq - 2):
+            if s >= 0:
+                try:
+                    store.delete_key(f"gar/{tag}/{s}/{me}")
+                except Exception:
+                    pass
+        _group_seq.pop(tag, None)
+
+
 def store_allreduce_group(store, v, ranks, op="sum", gid=None):
     """MEMBER-ONLY subgroup all-reduce over the TCPStore: each member posts
     its value under a sequenced group key, waits for all members' posts, and

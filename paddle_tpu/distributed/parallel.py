@@ -21,11 +21,26 @@ __all__ = ["DataParallel", "group_sharded_parallel", "save_group_sharded_model"]
 
 
 class DataParallel(nn.Layer):
+    _warned = False
+
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
                  group=None):
         super().__init__()
         self._layers = layers
+        if not DataParallel._warned:
+            DataParallel._warned = True
+            import warnings
+
+            warnings.warn(
+                "paddle_tpu DataParallel is a pass-through wrapper: grad "
+                "averaging happens inside the compiled step (GSPMD inserts "
+                "the all-reduce); comm_buffer_size / find_unused_parameters "
+                "are accepted for API parity and ignored. In a "
+                "multi-controller run, plain loss.backward(); opt.step() "
+                "does NOT sync grads — drive training through "
+                "DistributedTrainStep or fleet.distributed_optimizer.",
+                stacklevel=2)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

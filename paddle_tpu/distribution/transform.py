@@ -259,6 +259,25 @@ class ChainTransform(Transform):
             shape = t.inverse_shape(shape)
         return shape
 
+    # composed event ranks (reference ChainTransform._domain/_codomain):
+    # walking the chain, each step consumes its domain rank and produces
+    # its codomain rank; excess rank passes through.
+    @property
+    def _domain_event_rank(self):
+        rank = 0
+        for t in reversed(self.transforms):
+            rank = t._domain_event_rank + max(
+                rank - t._codomain_event_rank, 0)
+        return rank
+
+    @property
+    def _codomain_event_rank(self):
+        rank = 0
+        for t in self.transforms:
+            rank = t._codomain_event_rank + max(
+                rank - t._domain_event_rank, 0)
+        return rank
+
 
 class IndependentTransform(Transform):
     """Reinterprets the rightmost `reinterpreted_batch_rank` dims as event
@@ -267,6 +286,14 @@ class IndependentTransform(Transform):
     def __init__(self, base, reinterpreted_batch_rank):
         self.base = base
         self.rank = int(reinterpreted_batch_rank)
+
+    @property
+    def _domain_event_rank(self):
+        return self.base._domain_event_rank + self.rank
+
+    @property
+    def _codomain_event_rank(self):
+        return self.base._codomain_event_rank + self.rank
 
     def _forward(self, x):
         return self.base._forward(x)
@@ -285,6 +312,28 @@ class ReshapeTransform(Transform):
     def __init__(self, in_event_shape, out_event_shape):
         self.in_event_shape = tuple(in_event_shape)
         self.out_event_shape = tuple(out_event_shape)
+
+    @property
+    def _domain_event_rank(self):
+        return len(self.in_event_shape)
+
+    @property
+    def _codomain_event_rank(self):
+        return len(self.out_event_shape)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.in_event_shape:
+            raise ValueError(
+                f"expected trailing dims {self.in_event_shape}, got {shape}")
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.out_event_shape:
+            raise ValueError(
+                f"expected trailing dims {self.out_event_shape}, got {shape}")
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
 
     def _forward(self, x):
         batch = x.shape[:x.ndim - len(self.in_event_shape)]
@@ -325,18 +374,40 @@ class StackTransform(Transform):
         return self._map(x, "_forward_log_det_jacobian")
 
 
+def _sum_rightmost(t, n):
+    """Sum a Tensor over its rightmost n dims (reference
+    transformed_distribution.py _sum_rightmost)."""
+    if n <= 0:
+        return t
+    return run_op("sum_rightmost",
+                  lambda v: v.sum(axis=tuple(range(-n, 0))), [t])
+
+
 class TransformedDistribution(Distribution):
     """reference: distribution/transformed_distribution.py — base sample
-    pushed through the transform; log_prob via the inverse + log-det."""
+    pushed through the transform; log_prob via the inverse + log-det,
+    with each transform's per-element log-det summed over the event dims
+    it is responsible for (the reference's _sum_rightmost bookkeeping)."""
 
     def __init__(self, base, transforms):
         if isinstance(transforms, Transform):
             transforms = [transforms]
         self.base = base
+        self._transforms = list(transforms)
         self.transform = (transforms[0] if len(transforms) == 1
                           else ChainTransform(transforms))
-        super().__init__(batch_shape=base.batch_shape,
-                         event_shape=base.event_shape)
+        chain = self.transform
+        base_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        if len(base_shape) < chain._domain_event_rank:
+            raise ValueError(
+                f"base distribution needs at least "
+                f"{chain._domain_event_rank} dims, got shape {base_shape}")
+        transformed_shape = chain.forward_shape(base_shape)
+        event_rank = chain._codomain_event_rank + max(
+            len(base.event_shape) - chain._domain_event_rank, 0)
+        cut = len(transformed_shape) - event_rank
+        super().__init__(batch_shape=tuple(transformed_shape[:cut]),
+                         event_shape=tuple(transformed_shape[cut:]))
 
     def sample(self, shape=()):
         return self.transform.forward(self.base.sample(shape))
@@ -345,11 +416,17 @@ class TransformedDistribution(Distribution):
         return self.transform.forward(self.base.rsample(shape))
 
     def log_prob(self, value):
-        t = self.transform
-        x = t.inverse(_f32(value))  # single inverse evaluation
-        base_lp = self.base.log_prob(x)
-
-        def fn(xv, base_lp_at_x):
-            return base_lp_at_x - t._forward_log_det_jacobian(xv)
-
-        return run_op("transformed_log_prob", fn, [x, base_lp])
+        event_rank = len(self.event_shape)
+        lp = None
+        y = _f32(value)
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            event_rank += t._domain_event_rank - t._codomain_event_rank
+            term = _sum_rightmost(t.forward_log_det_jacobian(x),
+                                  event_rank - t._domain_event_rank)
+            lp = term if lp is None else lp + term
+            y = x
+        base_lp = _sum_rightmost(
+            self.base.log_prob(y),
+            event_rank - len(self.base.event_shape))
+        return base_lp - lp if lp is not None else base_lp

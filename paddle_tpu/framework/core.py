@@ -130,12 +130,16 @@ class GradNode:
     paddle/fluid/eager/tensor_wrapper.h:39).
     """
 
-    __slots__ = ("id", "name", "vjp_fn", "inputs", "out_avals", "weak_outputs")
+    __slots__ = ("id", "name", "vjp_fn", "fwd_fn", "inputs", "out_avals",
+                 "weak_outputs")
 
-    def __init__(self, name, vjp_fn, inputs, out_avals):
+    def __init__(self, name, vjp_fn, inputs, out_avals, fwd_fn=None):
         self.id = next(_node_counter)
         self.name = name
         self.vjp_fn = vjp_fn
+        # the pure forward fn — lets autograd.grad(create_graph=True)
+        # replay the subgraph functionally and differentiate through it
+        self.fwd_fn = fwd_fn
         self.inputs = inputs  # list[Tensor]
         self.out_avals = out_avals  # list[jax.ShapeDtypeStruct]
         self.weak_outputs = []  # list[weakref.ref[Tensor]], set by run_op
@@ -286,6 +290,9 @@ class Tensor:
         # True when the value was materialized from host data (to_tensor on
         # scalars/ndarrays) — a frame CONSTANT the SOT capture may bake
         "_host_const",
+        # True for PRNG-key tensors (framework.random.rng_tensor): the SOT
+        # capture must re-draw these per replay, never bake or reuse them
+        "_rng_key",
         "__weakref__",
     )
 
@@ -297,6 +304,7 @@ class Tensor:
         _tensor_ctr += 1
         self._ctr = _tensor_ctr
         self._host_const = False
+        self._rng_key = False
         self.stop_gradient = stop_gradient
         self.grad = None
         self._grad_node = None
@@ -988,15 +996,15 @@ def _run_op_impl(name: str, fn: Callable, inputs: Sequence, n_outputs: int | Non
     out, vjp_fn = jax.vjp(fn, *values)
     if failed_pair is not None:
         _mark_uncacheable(failed_pair)
-    return _wrap_grad_outputs(name, out, vjp_fn, tensors)
+    return _wrap_grad_outputs(name, out, vjp_fn, tensors, fn)
 
 
-def _wrap_grad_outputs(name, out, vjp_fn, tensors):
+def _wrap_grad_outputs(name, out, vjp_fn, tensors, fwd_fn=None):
     """Tape wiring shared by the cached and uncached grad paths."""
     multi = isinstance(out, tuple)
     outs = out if multi else (out,)
     avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
-    node = GradNode(name, vjp_fn, tensors, avals)
+    node = GradNode(name, vjp_fn, tensors, avals, fwd_fn)
     result = []
     for i, o in enumerate(outs):
         t = Tensor(o, stop_gradient=False)
@@ -1019,4 +1027,4 @@ def _finish_op(name, out, res, entry, tensors, need_grad):
             f"dispatch cache: op '{name}' retraced with a different residual "
             "structure; clear_dispatch_cache() and report this op")
     vjp_fn = lambda cts: bwd(res, cts)  # noqa: E731
-    return _wrap_grad_outputs(name, out, vjp_fn, tensors)
+    return _wrap_grad_outputs(name, out, vjp_fn, tensors, entry[4])

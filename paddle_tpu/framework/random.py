@@ -39,6 +39,20 @@ def next_key():
     return sub
 
 
+def rng_tensor():
+    """A fresh subkey wrapped as a Tensor and tagged `_rng_key`.
+
+    Random ops must pass THIS as a run_op input (never close over the raw
+    key): the tagged input keeps the op's closure hashable for the dispatch
+    cache, and tells the SOT capture (jit/sot.py) to re-draw the key on
+    every segment replay instead of freezing the record-time draw."""
+    from .core import Tensor
+
+    t = Tensor(next_key(), stop_gradient=True)
+    t._rng_key = True
+    return t
+
+
 def get_rng_state():
     return (_get_key(),)
 

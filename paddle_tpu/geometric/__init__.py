@@ -1,0 +1,233 @@
+"""Graph learning ops (reference: python/paddle/geometric/).
+
+TPU design: segment reductions and gather-scatter message passing map
+directly onto ``jax.ops.segment_*`` — XLA lowers them to sorted-scatter
+fusions, which is the TPU-efficient formulation of the reference's CUDA
+atomics kernels (phi/kernels/gpu/graph_send_recv_kernel.cu,
+segment_pool_kernel.cu). Neighbor sampling and reindexing have
+data-dependent output sizes, so they run host-side (matching their
+CPU-bound role in GNN data pipelines).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, to_tensor
+
+__all__ = [
+    "segment_sum",
+    "segment_mean",
+    "segment_min",
+    "segment_max",
+    "send_u_recv",
+    "send_ue_recv",
+    "send_uv",
+    "reindex_graph",
+    "sample_neighbors",
+]
+
+
+def _np(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+def _n_segments(segment_ids, out_size=None):
+    if out_size is not None:
+        return int(out_size if not isinstance(out_size, Tensor)
+                   else _np(out_size))
+    ids = _np(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(op_name, jop, x, segment_ids, n):
+    def fn(xv, ids):
+        return jop(xv, ids.astype(jnp.int32), num_segments=n)
+
+    return run_op(op_name, fn, [x, segment_ids])
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference geometric/math.py:29."""
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids,
+                    _n_segments(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    """reference geometric/math.py:88."""
+    n = _n_segments(segment_ids)
+
+    def fn(xv, ids):
+        ids = ids.astype(jnp.int32)
+        tot = jax.ops.segment_sum(xv, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((xv.shape[0],), xv.dtype), ids,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (xv.ndim - 1))
+
+    return run_op("segment_mean", fn, [data, segment_ids])
+
+
+def segment_min(data, segment_ids, name=None):
+    """reference geometric/math.py:149. Empty segments yield 0 (reference
+    semantics)."""
+    n = _n_segments(segment_ids)
+
+    def fn(xv, ids):
+        ids = ids.astype(jnp.int32)
+        out = jax.ops.segment_min(xv, ids, num_segments=n)
+        has = jax.ops.segment_sum(jnp.ones((xv.shape[0],), xv.dtype), ids,
+                                  num_segments=n) > 0
+        return jnp.where(has.reshape((-1,) + (1,) * (xv.ndim - 1)), out, 0)
+
+    return run_op("segment_min", fn, [data, segment_ids])
+
+
+def segment_max(data, segment_ids, name=None):
+    """reference geometric/math.py:209."""
+    n = _n_segments(segment_ids)
+
+    def fn(xv, ids):
+        ids = ids.astype(jnp.int32)
+        out = jax.ops.segment_max(xv, ids, num_segments=n)
+        has = jax.ops.segment_sum(jnp.ones((xv.shape[0],), xv.dtype), ids,
+                                  num_segments=n) > 0
+        return jnp.where(has.reshape((-1,) + (1,) * (xv.ndim - 1)), out, 0)
+
+    return run_op("segment_max", fn, [data, segment_ids])
+
+
+_SEG = {"sum": jax.ops.segment_sum, "mean": None,
+        "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def _reduce_messages(msg, dst, n, reduce_op):
+    dst = dst.astype(jnp.int32)
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (msg.ndim - 1))
+    out = _SEG[reduce_op](msg, dst, num_segments=n)
+    if reduce_op in ("max", "min"):
+        has = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments=n) > 0
+        out = jnp.where(has.reshape((-1,) + (1,) * (msg.ndim - 1)), out, 0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce into dst slots (reference send_recv.py:55;
+    kernel graph_send_recv_kernel.cu)."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    n = (_n_segments(dst_index) if out_size is None
+         else _n_segments(dst_index, out_size))
+    if out_size is None:
+        n = max(n, int(x.shape[0]))
+
+    def fn(xv, src, dst):
+        msg = xv[src.astype(jnp.int32)]
+        return _reduce_messages(msg, dst, n, reduce_op)
+
+    return run_op("send_u_recv", fn, [x, src_index, dst_index])
+
+
+_MSG_OPS = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Message = x[src] (op) y[edge]; reduce into dst (reference
+    send_recv.py:210)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    n = (_n_segments(dst_index) if out_size is None
+         else _n_segments(dst_index, out_size))
+    if out_size is None:
+        n = max(n, int(x.shape[0]))
+
+    def fn(xv, yv, src, dst):
+        msg = _MSG_OPS[message_op](xv[src.astype(jnp.int32)], yv)
+        return _reduce_messages(msg, dst, n, reduce_op)
+
+    return run_op("send_ue_recv", fn, [x, y, src_index, dst_index])
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (reference send_recv.py:413)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def fn(xv, yv, src, dst):
+        return _MSG_OPS[message_op](xv[src.astype(jnp.int32)],
+                                    yv[dst.astype(jnp.int32)])
+
+    return run_op("send_uv", fn, [x, y, src_index, dst_index])
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact (x ∪ neighbors) into contiguous ids (reference
+    reindex.py:34). Host-side: output size is data-dependent."""
+    xv = _np(x).astype(np.int64)
+    nb = _np(neighbors).astype(np.int64)
+    cnt = _np(count).astype(np.int64)
+    order = {}
+    for v in xv.tolist():
+        if v not in order:
+            order[v] = len(order)
+    for v in nb.tolist():
+        if v not in order:
+            order[v] = len(order)
+    mapping = np.asarray(list(order.keys()), np.int64)
+    reindex_src = np.asarray([order[v] for v in nb.tolist()], np.int64)
+    reindex_dst = np.repeat(np.asarray(
+        [order[v] for v in xv.tolist()], np.int64), cnt)
+    return (to_tensor(reindex_src), to_tensor(reindex_dst),
+            to_tensor(mapping))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Sample up to sample_size neighbors per input node from CSC
+    (reference sampling/neighbors.py:30). Host-side (ragged output)."""
+    r = _np(row).astype(np.int64)
+    cp = _np(colptr).astype(np.int64)
+    nodes = _np(input_nodes).astype(np.int64)
+    rng = np.random.default_rng()
+    out_nb, out_cnt, out_eid = [], [], []
+    ev = _np(eids).astype(np.int64) if eids is not None else None
+    for nd in nodes.tolist():
+        beg, end = int(cp[nd]), int(cp[nd + 1])
+        neigh = r[beg:end]
+        eid = ev[beg:end] if ev is not None else None
+        if 0 <= sample_size < len(neigh):
+            sel = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh = neigh[sel]
+            if eid is not None:
+                eid = eid[sel]
+        out_nb.append(neigh)
+        out_cnt.append(len(neigh))
+        if eid is not None:
+            out_eid.append(eid)
+    nb = to_tensor(np.concatenate(out_nb)
+                   if out_nb else np.empty(0, np.int64))
+    cnt = to_tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        return nb, cnt, to_tensor(np.concatenate(out_eid))
+    return nb, cnt

@@ -9,3 +9,9 @@ __all__.append("distributed")
 from . import optimizer  # noqa: E402,F401
 from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
 __all__ += ["optimizer", "LookAhead", "ModelAverage"]
+from . import operators  # noqa: E402,F401
+from .operators import (  # noqa: E402,F401
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+__all__ += ["operators", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

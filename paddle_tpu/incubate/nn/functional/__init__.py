@@ -321,9 +321,7 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
         return run_op("fused_dropout_add", lambda a, b: a + b, ins)
     from ....framework import random as rnd
 
-    key = rnd.next_key()
-
-    def fn(a, b):
+    def fn(a, b, key):
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
         if mode == "upscale_in_train":
             d = jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
@@ -331,7 +329,7 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
             d = jnp.where(keep, a, 0.0).astype(a.dtype)
         return d + b
 
-    return run_op("fused_dropout_add", fn, ins)
+    return run_op("fused_dropout_add", fn, ins + [rnd.rng_tensor()])
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):

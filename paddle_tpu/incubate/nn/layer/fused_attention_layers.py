@@ -79,9 +79,12 @@ class FusedMultiHeadAttention(nn.Layer):
             self.qkv_weight.dist_attr = P(None, "mp", None, None)
             if self.qkv_bias is not None:
                 self.qkv_bias.dist_attr = P(None, "mp", None)
-        self.linear_weight.dist_attr = P("mp", None)
-        self.linear_weight.is_distributed = True
-        if not transpose_qkv_wb:  # [E, 3E] layout stays replicated
+            # out-proj row-parallel only when qkv is head-sharded; the
+            # transpose_qkv_wb [E, 3E] layout keeps BOTH replicated (a
+            # row-parallel out-proj against an unsharded context would
+            # mis-shard the matmul and the grad-norm accounting)
+            self.linear_weight.dist_attr = P("mp", None)
+            self.linear_weight.is_distributed = True
             self.qkv_weight.is_distributed = True
             if self.qkv_bias is not None:
                 self.qkv_bias.is_distributed = True

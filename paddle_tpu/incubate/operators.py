@@ -1,0 +1,43 @@
+"""Fused incubate operators (reference: python/paddle/incubate/operators/).
+
+TPU note: both softmax-mask fusions are expressed as single jax functions
+under one run_op — XLA fuses the add + masked softmax into one HBM pass on
+TPU, which is all the reference's hand-written CUDA kernel
+(paddle/phi/kernels/fusion/gpu/fused_softmax_mask_kernel.cu) buys on GPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused pass (reference:
+    python/paddle/incubate/operators/softmax_mask_fuse.py:26; kernel
+    fused_softmax_mask_kernel.cu). x: [B, H, S, S] scores, mask
+    broadcastable [B, 1, S, S] additive mask."""
+
+    def fn(a, m):
+        return jax.nn.softmax(a + m.astype(a.dtype), axis=-1)
+
+    return run_op("fused_softmax_mask", fn, [x, mask])
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax over the causal (lower-triangle-visible) scores: positions
+    j > i get -inf before the softmax (reference:
+    python/paddle/incubate/operators/softmax_mask_fuse_upper_triangle.py:26;
+    kernel fused_softmax_mask_upper_triangle_kernel.cu). x: [B, H, S, S]."""
+
+    def fn(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        neg = jnp.asarray(jnp.finfo(a.dtype).min, a.dtype)
+        return jax.nn.softmax(jnp.where(causal, a, neg), axis=-1)
+
+    return run_op("fused_softmax_mask_upper_triangle", fn, [x])

@@ -49,14 +49,26 @@ class Config:
     def prog_file(self):
         return (self._prefix or "") + ".pdmodel"
 
+    def _noop(self, knob):
+        # parity shims must not be SILENT no-ops (they mask user error):
+        # one debug line per knob, once
+        if knob not in self._flags:
+            self._flags[knob] = True
+            import logging
+
+            logging.getLogger(__name__).info(
+                "inference.Config.%s is a no-op on TPU: device placement, "
+                "memory planning and graph optimization are owned by "
+                "XLA/PJRT", knob)
+
     def enable_use_gpu(self, *a, **kw):
-        self._flags["gpu"] = True
+        self._noop("enable_use_gpu")
 
     def enable_memory_optim(self, *a, **kw):
-        self._flags["memory_optim"] = True
+        self._noop("enable_memory_optim")
 
     def switch_ir_optim(self, *a, **kw):
-        pass
+        self._noop("switch_ir_optim")
 
     def disable_glog_info(self):
         pass
@@ -126,3 +138,6 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+from . import serving  # noqa: E402
+from .serving import ContinuousBatchingEngine, GenerationRequest  # noqa: E402

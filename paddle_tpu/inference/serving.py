@@ -1,0 +1,205 @@
+"""Continuous-batching generation engine (the serving-engine depth of
+reference L13 — fastdeploy/llm serving's dynamic batching scheduler — on
+top of the decode path in models/generation.py).
+
+TPU-first design: ONE compiled decode program of fixed shape
+[max_batch_size, 1] runs every step regardless of how many requests are
+live — slots hold per-row cache offsets (models/gpt.py _dyn_update /
+_decode_mask vector-offset path), so admission/retirement never
+recompiles. Prefill pads prompts to power-of-two length buckets to bound
+compile count. This is the vLLM/fastdeploy scheduling idea expressed as
+static shapes + masking instead of dynamic batch reshaping — the form XLA
+wants.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["GenerationRequest", "ContinuousBatchingEngine"]
+
+
+class GenerationRequest:
+    """One prompt in flight."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids, max_new_tokens=32, temperature=0.0,
+                 eos_token_id=None):
+        self.req_id = next(self._ids)
+        self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.generated: list[int] = []
+        self.done = False
+
+    @property
+    def output_ids(self):
+        return np.concatenate([self.prompt,
+                               np.asarray(self.generated, np.int32)])
+
+
+def _bucket(n):
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+class ContinuousBatchingEngine:
+    """Admit-while-decoding scheduler over a slotted KV cache.
+
+    add_request() enqueues; step() admits waiting requests into free slots
+    (prefill) and advances every live slot by one token (single fixed-shape
+    decode). run() drains everything and returns finished requests.
+    """
+
+    def __init__(self, model, max_batch_size=8, max_seq_len=512, seed=0):
+        model.eval()
+        self.model = model
+        self.cfg = model.config
+        self.B = int(max_batch_size)
+        self.S = int(max_seq_len)
+        self.params = {k: p._value for k, p in model.named_parameters()}
+        self.buffers = {k: b._value for k, b in model.named_buffers()}
+        cfg = self.cfg
+        self.caches = [
+            (jnp.zeros((self.B, self.S, cfg.kv_heads, cfg.head_dim),
+                       jnp.float32),) * 2
+            for _ in range(cfg.num_layers)]
+        self.lengths = np.zeros(self.B, np.int32)   # tokens in each slot
+        self.active: list[GenerationRequest | None] = [None] * self.B
+        self.last_tok = np.zeros(self.B, np.int32)
+        self.waiting: collections.deque = collections.deque()
+        self.finished: list[GenerationRequest] = []
+        self._key = jax.random.PRNGKey(seed)
+        self._prefill_cache = {}
+        self._decode_jit = None
+
+    # ------------------------------------------------------------------ #
+
+    def add_request(self, prompt_ids, **kw):
+        req = GenerationRequest(prompt_ids, **kw)
+        if len(req.prompt) >= self.S:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} >= max_seq_len {self.S}")
+        self.waiting.append(req)
+        return req.req_id
+
+    def _functional_forward(self, p, b, tok, pos, caches, off):
+        from ..jit import functional_call
+
+        c = [(Tensor(k_), Tensor(v_)) for k_, v_ in caches]
+        (logits, new_c), _ = functional_call(
+            self.model, p, b, [Tensor(tok), Tensor(pos), c, Tensor(off)],
+            train=False)
+        return logits, new_c
+
+    # ------------------------------------------------------------------ #
+
+    def _admit(self):
+        free = [i for i in range(self.B) if self.active[i] is None]
+        while free and self.waiting:
+            slot = free.pop(0)
+            req = self.waiting.popleft()
+            n = len(req.prompt)
+            Sp = _bucket(n)
+            pf = self._prefill_cache.get(Sp)
+            if pf is None:
+                def prefill(p, b, tok, pos, caches):
+                    # batch-1 prefill with a fresh (zero) cache view
+                    logits, new_c = self._functional_forward(
+                        p, b, tok, pos, caches, jnp.int32(0))
+                    return logits, new_c
+
+                pf = jax.jit(prefill)
+                self._prefill_cache[Sp] = pf
+            tok = np.zeros((1, Sp), np.int32)
+            tok[0, :n] = req.prompt
+            pos = np.arange(Sp, dtype=np.int32)[None]
+            cfg = self.cfg
+            zero_c = [(jnp.zeros((1, Sp, cfg.kv_heads, cfg.head_dim),
+                                 jnp.float32),) * 2
+                      for _ in range(cfg.num_layers)]
+            logits, new_c = pf(self.params, self.buffers,
+                               jnp.asarray(tok), jnp.asarray(pos), zero_c)
+            # scatter the prompt's kv into this slot's cache rows [0, n)
+            for li, (k_, v_) in enumerate(new_c):
+                bk, bv = self.caches[li]
+                bk = bk.at[slot, :n].set(k_[0, :n])
+                bv = bv.at[slot, :n].set(v_[0, :n])
+                self.caches[li] = (bk, bv)
+            first = self._pick_token(
+                np.asarray(logits)[0, n - 1], req)
+            self.active[slot] = req
+            self.lengths[slot] = n
+            self.last_tok[slot] = first
+            self._emit(slot, first)
+
+    def _pick_token(self, logits_row, req):
+        if req.temperature == 0.0:
+            return int(np.argmax(logits_row))
+        self._key, sub = jax.random.split(self._key)
+        return int(jax.random.categorical(
+            sub, jnp.asarray(logits_row) / req.temperature))
+
+    def _emit(self, slot, tok):
+        req = self.active[slot]
+        req.generated.append(int(tok))
+        hit_eos = (req.eos_token_id is not None
+                   and int(tok) == req.eos_token_id)
+        if (hit_eos or len(req.generated) >= req.max_new_tokens
+                or self.lengths[slot] + 1 >= self.S):
+            req.done = True
+            self.finished.append(req)
+            self.active[slot] = None
+            self.lengths[slot] = 0
+
+    # ------------------------------------------------------------------ #
+
+    def step(self):
+        """One scheduler tick: admit then decode-advance all live slots.
+        Returns {req_id: new_token} for tokens produced this tick."""
+        self._admit()
+        live = [i for i in range(self.B) if self.active[i] is not None]
+        if not live:
+            return {}
+        if self._decode_jit is None:
+            def decode(p, b, tok, offs, caches):
+                pos = offs[:, None]
+                logits, new_c = self._functional_forward(
+                    p, b, tok[:, None], pos, caches, offs)
+                return logits[:, -1], new_c
+
+            self._decode_jit = jax.jit(decode, donate_argnums=(4,))
+
+        offs = jnp.asarray(self.lengths)  # per-slot write offset
+        logits, self.caches = self._decode_jit(
+            self.params, self.buffers, jnp.asarray(self.last_tok), offs,
+            self.caches)
+        logits = np.asarray(logits)
+        out = {}
+        for i in live:
+            req = self.active[i]
+            tok = self._pick_token(logits[i], req)
+            self.lengths[i] += 1
+            self.last_tok[i] = tok
+            out[req.req_id] = tok
+            self._emit(i, tok)
+        return out
+
+    def run(self):
+        """Drain: step until every queued/live request finishes; returns
+        the finished requests in completion order."""
+        while self.waiting or any(r is not None for r in self.active):
+            self.step()
+        done, self.finished = self.finished, []
+        return done

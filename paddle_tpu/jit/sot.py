@@ -28,9 +28,10 @@ Safety valves (fall back to plain eager — the always-correct behavior):
 - guard-tree explosion (continuous float guards taking a fresh branch every
   call) -> capture disables itself instead of re-recording forever.
 
-Known limitation: RNG draws inside recorded segments are frozen at record
-time (dropout masks replay identically); capture is keyed per layer
-training mode by the to_static integration.
+RNG: PRNG-key tensors (framework.random.rng_tensor, tagged `_rng_key`) are
+recorded as ("r", slot) entries and re-drawn from the global key on EVERY
+replay — dropout masks vary per step exactly as in eager. Capture is keyed
+per layer training mode by the to_static integration.
 
 Values are named by deterministic value numbers (arg slot / op-output
 ordinal / external), so paths recorded in different runs share a consistent
@@ -74,6 +75,7 @@ class _Segment:
         self.ops = ops  # (fn, entries, out_vnums)
         need, produced, seen = [], [], set()
         xs, xseen = [], set()
+        rs = []
         for _fn, entries, out_vnums in ops:
             for e in entries:
                 if e[0] in ("a", "v", "e") and e[:2] not in seen \
@@ -83,13 +85,18 @@ class _Segment:
                 elif e[0] == "x" and id(e[1]) not in xseen:
                     xs.append(e[1])
                     xseen.add(id(e[1]))
+                elif e[0] == "r" and e[:2] not in seen:
+                    rs.append(e[:2])
+                    seen.add(e[:2])
             produced.extend(("v", n) for n in out_vnums)
         self.needed = [e for e in need if e not in produced]
         self.ext_objs = xs  # live tensors appended to the input list
+        self.rng_entries = rs  # PRNG-key slots: fresh draw per run
         self.produced = produced
         needed = self.needed
         n_named = len(needed)
         x_index = {id(o): n_named + j for j, o in enumerate(xs)}
+        r_index = {e: n_named + len(xs) + j for j, e in enumerate(rs)}
 
         def replay(*vals):
             local = dict(zip(needed, vals[:n_named]))
@@ -99,6 +106,8 @@ class _Segment:
                     return e[1]
                 if e[0] == "x":
                     return vals[x_index[id(e[1])]]
+                if e[0] == "r":
+                    return vals[r_index[e[:2]]]
                 return local[e[:2]]
 
             with tracing_guard(True):
@@ -117,6 +126,10 @@ class _Segment:
 
     def run(self, env):
         args = [env[k] for k in self.needed] + self.ext_objs
+        if self.rng_entries:
+            from ..framework import random as rnd
+
+            args += [rnd.next_key() for _ in self.rng_entries]
         produced = self.produced
         outs = run_op("sot_segment", self._replay, args,
                       n_outputs=len(produced) if len(produced) != 1 else None)
@@ -299,6 +312,7 @@ class SOTCapture:
             if isinstance(a, Tensor):
                 names[id(a)] = ("a", i)
         counter = [0]
+        rng_slots = [0]  # fresh-key slots handed out to ("r", j) entries
         seg_ops = []
         cur = {"node": root}
         ext = getattr(root, "_ext", None)
@@ -323,6 +337,15 @@ class SOTCapture:
                 names[id(t)] = k
                 return k
             if t._ctr >= start_ctr:
+                if getattr(t, "_rng_key", False):
+                    # PRNG key drawn during the frame (dropout etc.): a new
+                    # slot whose replay value is a FRESH draw every run —
+                    # never bake, or masks replay identically (the reference
+                    # SOT re-seeds per step for the same reason)
+                    k = ("r", rng_slots[0])
+                    rng_slots[0] += 1
+                    names[id(t)] = k
+                    return k
                 if t._host_const:
                     # materialized from host data during the frame (scalar
                     # promotion, np constant): a true frame constant

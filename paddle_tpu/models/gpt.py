@@ -226,18 +226,29 @@ class GPTAttention(nn.Layer):
 
 
 def _dyn_update(buf, new, off):
-    """Write `new` [B,S,H,D] into static cache `buf` at sequence offset `off`."""
-    off = jnp.asarray(off).astype(jnp.int32).reshape(())
-    return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype), (0, off, 0, 0))
+    """Write `new` [B,S,H,D] into static cache `buf` at sequence offset
+    `off`. A VECTOR off [B] writes per-row offsets (continuous-batching
+    decode, S==1: each slot appends at its own length)."""
+    off = jnp.asarray(off).astype(jnp.int32)
+    if off.ndim == 0:
+        return jax.lax.dynamic_update_slice(
+            buf, new.astype(buf.dtype), (0, off.reshape(()), 0, 0))
+    B = buf.shape[0]
+    return buf.at[jnp.arange(B), off].set(new[:, 0].astype(buf.dtype))
 
 
 def _decode_mask(s_max, offset, s_new):
-    """Bool mask [1,1,S_new,S_max]: position i (absolute off+i) attends to j<=off+i."""
+    """Bool mask: position i (absolute off+i) attends to j<=off+i.
+    Scalar offset -> [1,1,S_new,S_max] (shared); vector offset [B] ->
+    [B,1,S_new,S_max] (per-slot lengths, continuous batching)."""
     def fn(off):
-        off = jnp.asarray(off).astype(jnp.int32).reshape(())
-        rows = off + jnp.arange(s_new)[:, None]
+        off = jnp.asarray(off).astype(jnp.int32)
         cols = jnp.arange(s_max)[None, :]
-        return (cols <= rows)[None, None]
+        if off.ndim == 0:
+            rows = off.reshape(()) + jnp.arange(s_new)[:, None]
+            return (cols <= rows)[None, None]
+        rows = off[:, None, None] + jnp.arange(s_new)[None, :, None]
+        return (cols[None] <= rows)[:, None]
 
     return run_op("decode_mask", fn, [offset])
 

@@ -1,6 +1,6 @@
 """paddle_tpu.nn (reference: python/paddle/nn/)."""
 
-from . import functional, initializer
+from . import functional, initializer, quant, utils
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer import layers as _layers_mod

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from ...framework.core import Tensor, run_op, to_tensor
 
 __all__ = [
+    "rrelu",
     "relu",
     "relu6",
     "relu_",
@@ -211,9 +212,7 @@ def glu(x, axis=-1, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...framework import random as rnd
 
-    key = rnd.next_key()
-
-    def fn(a):
+    def fn(a, key):
         g = jax.random.gumbel(key, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
@@ -222,7 +221,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             return oh + y - jax.lax.stop_gradient(y)
         return y
 
-    return run_op("gumbel_softmax", fn, [_t(x)])
+    return run_op("gumbel_softmax", fn, [_t(x), rnd.rng_tensor()])
 
 
 def maxout(x, groups, axis=1, name=None):
@@ -241,3 +240,27 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
         lambda a: jnp.where(a > threshold, a, jnp.asarray(value, a.dtype)),
         [_t(x)],
     )
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    """Randomized leaky ReLU (reference nn/functional/activation.py rrelu;
+    kernel rrelu_kernel.cu). Training: per-element negative slope ~
+    U(lower, upper); inference: fixed slope (lower+upper)/2. The key rides
+    in as a tagged input (framework.random.rng_tensor) so the op stays
+    dispatch-cacheable and SOT-replayable."""
+    if not 0 <= lower <= upper <= 1:
+        raise ValueError(
+            f"rrelu expects 0 <= lower <= upper <= 1, got {lower}, {upper}")
+    if not training:
+        slope = (lower + upper) / 2.0
+        return run_op(
+            "rrelu_eval",
+            lambda a: jnp.where(a >= 0, a, a * jnp.asarray(slope, a.dtype)),
+            [_t(x)])
+    from ...framework import random as rnd
+
+    def fn(a, key):
+        s = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+        return jnp.where(a >= 0, a, a * s)
+
+    return run_op("rrelu_train", fn, [_t(x), rnd.rng_tensor()])

@@ -68,9 +68,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         return xx
     if p == 1.0:
         return run_op("dropout_all", lambda a: jnp.zeros_like(a), [xx])
-    key = rnd.next_key()
-
-    def fn(a):
+    def fn(a, key):
         shape = list(a.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
@@ -80,7 +78,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
             return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype)).astype(a.dtype)
         return jnp.where(keep, a, jnp.zeros((), a.dtype))
 
-    return run_op("dropout", fn, [xx])
+    return run_op("dropout", fn, [xx, rnd.rng_tensor()])
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -97,19 +95,18 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     xx = _t(x)
     if not training or p == 0.0:
         return xx
-    key = rnd.next_key()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
 
-    def fn(a):
+    def fn(a, key):
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
         q = 1.0 - p
         a_coef = (q + alpha_p**2 * q * p) ** -0.5
         b_coef = -a_coef * alpha_p * p
         return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
 
-    return run_op("alpha_dropout", fn, [xx])
+    return run_op("alpha_dropout", fn, [xx, rnd.rng_tensor()])
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

@@ -26,7 +26,59 @@ __all__ = [
     "log_loss",
     "ctc_loss",
     "sigmoid_focal_loss",
+    "hsigmoid_loss",
+    "margin_cross_entropy",
+    "class_center_sample",
 ]
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (reference nn/functional/common.py
+    class_center_sample; kernel class_center_sample_kernel.cu). Keeps all
+    positive centers, pads with uniformly sampled negatives, remaps labels
+    into the sampled index space. Host-side: the sampled set size is
+    data-dependent.
+
+    Model-parallel (`group` a comm group): each rank samples within its
+    own class shard; positives are shared via an object all-gather so every
+    rank remaps consistently (reference's NCCLAllGather of positives)."""
+    mp = group is not None and group is not False
+
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    lab = lab.reshape(-1).astype(np.int64)
+    if mp and not isinstance(group, bool):
+        from ...distributed import collective as dist
+        from ...distributed.env import get_rank
+
+        all_lab = lab
+        if group.nranks > 1:
+            gathered = []
+            dist.all_gather_object(gathered, lab.tolist(), group)
+            all_lab = np.asarray(sorted(
+                {v for part in gathered for v in part}), np.int64)
+        nranks = group.nranks
+        rank = group.ranks.index(get_rank())
+    else:
+        all_lab = lab
+        nranks, rank = 1, 0
+    per = num_classes  # classes on THIS rank's shard
+    offset = rank * per if nranks > 1 else 0
+    in_shard = (all_lab >= offset) & (all_lab < offset + per)
+    pos = np.unique(all_lab[in_shard] - offset)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(per), pos, assume_unique=True)
+        extra = np.random.default_rng().choice(
+            neg_pool, size=num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = np.full(per, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    own = (lab >= offset) & (lab < offset + per)
+    new_label = np.where(own, remap[np.clip(lab - offset, 0, per - 1)],
+                         lab)
+    return (to_tensor(new_label.astype(np.int64)),
+            to_tensor(sampled.astype(np.int64)))
 
 
 def _t(x):
@@ -357,3 +409,147 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, red
         return _reduce(loss, reduction)
 
     return run_op("sigmoid_focal_loss", fn, ins)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py
+    hsigmoid_loss; kernel phi/kernels/cpu/hsigmoid_loss_kernel.cc).
+
+    Default tree: complete binary tree with heap indexing — leaf for class
+    l sits at heap position l + num_classes; the root-to-leaf path visits
+    internal nodes (l+C)>>1, (l+C)>>2, ..., 1 and the step's code bit is
+    the corresponding bit of l+C. Internal node n uses weight row n-1.
+    Custom trees come in via path_table/path_code (both [N, L], -1 padded).
+    One gather + one matmul per batch — no per-node loop."""
+    import math
+
+    C = int(num_classes)
+    use_custom = path_table is not None
+
+    if use_custom:
+        def fn(x, lab, pt, pc, w, *rest):
+            b = rest[0] if rest else None
+            mask = (pt >= 0).astype(x.dtype)
+            rows = jnp.clip(pt, 0, w.shape[0] - 1).astype(jnp.int32)
+            wv = w[rows]                       # [N, L, D]
+            logit = jnp.einsum("nd,nld->nl", x, wv)
+            if b is not None:
+                logit = logit + b[rows].reshape(logit.shape)
+            code = pc.astype(x.dtype)
+            # BCE with logit: softplus(logit) - code*logit
+            per = (jax.nn.softplus(logit) - code * logit) * mask
+            return per.sum(-1, keepdims=True)
+
+        ins = [input, label, path_table, path_code, weight]
+        if bias is not None:
+            ins.append(bias)
+        return run_op("hsigmoid_loss", fn, ins)
+
+    depth = max(int(math.ceil(math.log2(max(C, 2)))), 1)
+
+    def fn(x, lab, w, *rest):
+        b = rest[0] if rest else None
+        heap = lab.astype(jnp.int32) + C        # [N]
+        ks = jnp.arange(depth, 0, -1)           # depth..1
+        anc = (heap[:, None] >> ks[None, :])    # ancestors root..parent
+        valid = (anc >= 1).astype(x.dtype)
+        code = ((heap[:, None] >> (ks[None, :] - 1)) & 1).astype(x.dtype)
+        rows = jnp.clip(anc - 1, 0, w.shape[0] - 1)
+        wv = w[rows]                            # [N, L, D]
+        logit = jnp.einsum("nd,nld->nl", x, wv)
+        if b is not None:
+            logit = logit + b[rows].reshape(logit.shape)
+        per = (jax.nn.softplus(logit) - code * logit) * valid
+        return per.sum(-1, keepdims=True)
+
+    ins = [input, label, weight]
+    if bias is not None:
+        ins.append(bias)
+    return run_op("hsigmoid_loss", fn, ins)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax CE (reference nn/functional/loss.py
+    margin_cross_entropy; kernel margin_cross_entropy_kernel.cu). The true
+    class logit cosθ becomes cos(m1·θ + m2) − m3, everything scales by s.
+
+    Model-parallel: when `group` is a communication group, `logits` is this
+    rank's class shard [N, C/world]; the softmax statistics (row max, exp
+    sum) and the target logit reduce over the group — the same three
+    collectives the reference's MP kernel issues.
+    """
+    from ...distributed import collective as dist
+    from ...distributed.env import get_rank
+
+    mp = group is not None and group is not False
+    if mp:
+        g = group if not isinstance(group, bool) else None
+        nranks = g.nranks if g is not None else 1
+        rank = (g.ranks.index(get_rank()) if g is not None else 0)
+    else:
+        nranks, rank = 1, 0
+    C_local = int(logits.shape[1])
+    offset = rank * C_local
+
+    def fn(lg, lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        local = (lab >= offset) & (lab < offset + C_local)
+        idx = jnp.clip(lab - offset, 0, C_local - 1)
+        rows = jnp.arange(lg.shape[0])
+        target = lg[rows, idx]
+        # margins on the cosine of the true class
+        theta = jnp.arccos(jnp.clip(target, -1.0, 1.0))
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        lg2 = lg.at[rows, idx].set(
+            jnp.where(local, modified, target))
+        return lg2 * scale
+
+    scaled = run_op("margin_logits", fn, [logits, label])
+
+    if nranks > 1:
+        # global softmax over the sharded class dim
+        mx = scaled.max(axis=1, keepdim=True)
+        dist.all_reduce(mx, op=dist.ReduceOp.MAX, group=group)
+        e = (scaled - mx).exp()
+        ssum = e.sum(axis=1, keepdim=True)
+        dist.all_reduce(ssum, group=group)
+        softmax = e / ssum
+
+        def tgt(lg, lab):
+            lab = lab.reshape(-1).astype(jnp.int32)
+            local = (lab >= offset) & (lab < offset + C_local)
+            idx = jnp.clip(lab - offset, 0, C_local - 1)
+            t = lg[jnp.arange(lg.shape[0]), idx]
+            return jnp.where(local, t, 0.0)
+
+        tlogit = run_op("margin_target", tgt, [scaled, label])
+        dist.all_reduce(tlogit, group=group)
+        mxv = run_op("margin_sq", lambda m: m.reshape(-1), [mx])
+        lsum = run_op("margin_lse", lambda s: jnp.log(s).reshape(-1),
+                      [ssum])
+        loss = lsum + mxv - tlogit
+        loss = loss.reshape([-1, 1])
+    else:
+        def lfn(lg, lab):
+            lab = lab.reshape(-1).astype(jnp.int32)
+            lse = jax.nn.logsumexp(lg, axis=1)
+            t = lg[jnp.arange(lg.shape[0]), lab]
+            return (lse - t).reshape(-1, 1)
+
+        loss = run_op("margin_ce", lfn, [scaled, label])
+        softmax = run_op("margin_softmax",
+                         lambda lg: jax.nn.softmax(lg, axis=1), [scaled])
+
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    elif reduction is not None:
+        raise ValueError(f"unknown reduction {reduction!r}")
+    if return_softmax:
+        return loss, softmax
+    return loss

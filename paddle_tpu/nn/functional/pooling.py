@@ -22,6 +22,13 @@ __all__ = [
     "adaptive_max_pool1d",
     "adaptive_max_pool2d",
     "adaptive_max_pool3d",
+    "max_unpool1d",
+    "max_unpool2d",
+    "max_unpool3d",
+    "lp_pool1d",
+    "lp_pool2d",
+    "fractional_max_pool2d",
+    "fractional_max_pool3d",
 ]
 
 
@@ -103,14 +110,29 @@ def _neg_inf(dtype):
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    if return_mask:
+        if ceil_mode:
+            raise NotImplementedError(
+                "max_pool1d: return_mask with ceil_mode is not supported")
+        return _max_pool_with_index(x, kernel_size, stride, padding, 1)
     return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, _neg_inf, ceil_mode, False, "NCL", False)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if ceil_mode or data_format != "NCHW":
+            raise NotImplementedError(
+                "max_pool2d: return_mask requires NCHW and ceil_mode=False")
+        return _max_pool_with_index(x, kernel_size, stride, padding, 2)
     return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, _neg_inf, ceil_mode, False, data_format, False)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if ceil_mode or data_format != "NCDHW":
+            raise NotImplementedError(
+                "max_pool3d: return_mask requires NCDHW and ceil_mode=False")
+        return _max_pool_with_index(x, kernel_size, stride, padding, 3)
     return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, _neg_inf, ceil_mode, False, data_format, False)
 
 
@@ -169,3 +191,237 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, False)
+
+
+# --------------------------------------------------------------------------- #
+# pooling tail: argmax masks, unpool, lp / fractional pools
+# (reference: python/paddle/nn/functional/pooling.py max_pool2d return_mask,
+#  max_unpool1d/2d/3d, lp_pool1d/2d; kernels phi/kernels/gpu/pool_kernel.cu,
+#  unpool_kernel.cu — here patch-extraction + argmax/scatter, which XLA
+#  lowers to one fused gather/scatter program)
+# --------------------------------------------------------------------------- #
+
+def _max_pool_with_index(x, kernel_size, stride, padding, n):
+    """Returns (pooled, mask) where mask holds flat indices into the input
+    spatial volume (paddle convention for max_pool*d(return_mask=True))."""
+    xx = _t(x)
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+    p = _pads(padding, n)
+
+    def fn(a):
+        spatial = a.shape[2:]
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding=list(p))
+        B, _CK, *out_sp = patches.shape
+        C = a.shape[1]
+        kk = int(np.prod(k))
+        # patches channel order is [C, *kernel] flattened C-major
+        pv = patches.reshape(B, C, kk, *out_sp)
+        # patches pads with ZEROS; mask padded taps to -inf so both the max
+        # value and the argmax match -inf-padded pooling semantics.
+        # tap (local kernel coords) -> global coord per dim:
+        tap = jnp.arange(kk)
+        tap_coords = []
+        rem = tap
+        for d in range(n - 1, -1, -1):
+            tap_coords.append(rem % k[d])
+            rem = rem // k[d]
+        tap_coords = tap_coords[::-1]  # per-dim [kk]
+        valid = None
+        glob = []
+        for d in range(n):
+            o = jnp.arange(out_sp[d]) * s[d] - p[d][0]
+            shape_t = [1, 1, kk] + [1] * n
+            shape_o = [1, 1, 1] + [1] * n
+            shape_o[3 + d] = out_sp[d]
+            g = (tap_coords[d].reshape(shape_t)
+                 + o.reshape(shape_o))  # [1,1,kk,...,out_d,...]
+            glob.append(g)
+            ok = (g >= 0) & (g < spatial[d])
+            valid = ok if valid is None else (valid & ok)
+        neg = jnp.asarray(jnp.finfo(a.dtype).min, a.dtype)
+        pv = jnp.where(valid, pv, neg)
+        idx_local = jnp.argmax(pv, axis=2)  # [B, C, *out_sp]
+        val = jnp.max(pv, axis=2)
+        flat = jnp.zeros_like(idx_local)
+        for d in range(n):
+            g_at = jnp.take_along_axis(
+                jnp.broadcast_to(glob[d], (1, 1, kk) + tuple(out_sp)),
+                idx_local[:, :, None], axis=2)[:, :, 0]
+            flat = flat + g_at * int(np.prod(spatial[d + 1:]))
+        return val, flat.astype(jnp.int32)
+
+    return run_op("max_pool_index", fn, [xx])
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n,
+                name):
+    xx = _t(x)
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+    p = _pads(padding, n)
+    if output_size is None:
+        out_sp = tuple(
+            (int(xx.shape[2 + d]) - 1) * s[d] - 2 * p[d][0] + k[d]
+            for d in range(n))
+    else:
+        out_sp = tuple(int(v) for v in output_size[-n:])
+
+    def fn(a, idx):
+        B, C = a.shape[0], a.shape[1]
+        flat_len = int(np.prod(out_sp))
+        av = a.reshape(B, C, -1)
+        iv = idx.reshape(B, C, -1).astype(jnp.int32)
+        out = jnp.zeros((B, C, flat_len), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, v, i: o.at[i].set(v)))(out, av, iv)
+        return out.reshape(B, C, *out_sp)
+
+    return run_op("max_unpool", fn, [xx, indices])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """reference nn/functional/pooling.py max_unpool1d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 1, name)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference nn/functional/pooling.py max_unpool2d (kernel
+    unpool_kernel.cu)."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 2, name)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """reference nn/functional/pooling.py max_unpool3d."""
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 3, name)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """reference nn/functional/pooling.py lp_pool1d: (sum x^p)^(1/p)."""
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode,
+                    1, data_format)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """reference nn/functional/pooling.py lp_pool2d (ops.yaml lp_pool2d)."""
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode,
+                    2, data_format)
+
+
+def _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode, n,
+             data_format):
+    xx = _t(x)
+    pnorm = float(norm_type)
+
+    def fn(a):
+        if pnorm == float("inf"):
+            raise ValueError("use max_pool for norm_type=inf")
+        return jnp.abs(a) ** pnorm
+
+    powed = run_op("lp_pow", fn, [xx])
+    pooled = _pool(powed, kernel_size, stride, padding, n, jax.lax.add,
+                   lambda dt: jnp.zeros((), dt), ceil_mode, True,
+                   data_format, False)
+    return run_op("lp_root",
+                  lambda a: a ** (1.0 / pnorm), [pooled])
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference nn/functional/pooling.py fractional_max_pool2d (ops.yaml
+    fractional_max_pool2d): pseudo-random bin boundaries from u."""
+    return _fractional_pool(x, output_size, random_u, return_mask, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference fractional_max_pool3d."""
+    return _fractional_pool(x, output_size, random_u, return_mask, 3)
+
+
+def _fractional_pool(x, output_size, random_u, return_mask, n):
+    xx = _t(x)
+    out = _tuple(output_size, n)
+    if random_u is None:
+        from ...framework import random as rnd
+        import jax.random as jrnd
+
+        u = float(jrnd.uniform(rnd.next_key(), ()))
+    else:
+        u = float(random_u)
+    spatial = [int(s) for s in xx.shape[2:]]
+    # per-dim bin edges: alpha = in/out, edge_i = ceil(alpha*(i+u)) - ceil(alpha*u)
+    sections = []
+    for d in range(n):
+        isz, osz = spatial[d], int(out[d])
+        alpha = isz / osz
+        base = int(np.ceil(alpha * u)) if u > 0 else 0
+        edges = [int(np.ceil(alpha * (i + u))) - base for i in range(osz + 1)]
+        edges[0] = 0
+        edges[-1] = isz
+        sections.append(edges)
+
+    def fn(a):
+        # pool dim by dim with variable bins (host-known boundaries);
+        # per-bin max via explicit slicing (static shapes per bin)
+        vals = a
+
+        def pool_dim(v, edges, ax):
+            outs = []
+            for i in range(len(edges) - 1):
+                sl = [slice(None)] * v.ndim
+                sl[ax] = slice(edges[i], max(edges[i + 1], edges[i] + 1))
+                outs.append(v[tuple(sl)].max(axis=ax, keepdims=True))
+            return jnp.concatenate(outs, axis=ax)
+
+        for d in range(n):
+            vals = pool_dim(vals, sections[d], 2 + d)
+        return vals
+
+    pooled = run_op("fractional_max_pool", fn, [xx])
+    if not return_mask:
+        return pooled
+
+    def mask_fn(a, pv):
+        # recover argmax flat index per output bin (scan bins, compare)
+        B, C = a.shape[0], a.shape[1]
+        av = a.reshape(B, C, *spatial)
+        out_shape = [int(o) for o in out]
+        m = jnp.zeros((B, C, *out_shape), jnp.int32)
+        import itertools as it
+
+        for bins in it.product(*[range(len(s) - 1) for s in sections]):
+            sl = [slice(None), slice(None)]
+            offs = []
+            for d, b in enumerate(bins):
+                lo = sections[d][b]
+                hi = max(sections[d][b + 1], lo + 1)
+                sl.append(slice(lo, hi))
+                offs.append(lo)
+            region = av[tuple(sl)].reshape(B, C, -1)
+            loc = jnp.argmax(region, axis=-1)
+            shp = [sl[2 + d].stop - sl[2 + d].start for d in range(n)]
+            coords = []
+            rem = loc
+            for d in range(n - 1, -1, -1):
+                coords.append(rem % shp[d] + offs[d])
+                rem = rem // shp[d]
+            coords = coords[::-1]
+            flat = jnp.zeros_like(loc)
+            for d in range(n):
+                flat = flat + coords[d] * int(np.prod(spatial[d + 1:]))
+            m = m.at[(slice(None), slice(None), *bins)].set(
+                flat.astype(jnp.int32))
+        return m
+
+    mask = run_op("fractional_max_pool_mask", mask_fn, [xx, pooled])
+    return pooled, mask

@@ -1,0 +1,209 @@
+"""Parameter reparameterization utilities (reference:
+python/paddle/nn/utils/ — weight_norm_hook.py, spectral_norm_hook.py,
+transform_parameters.py, clip_grad_norm_.py).
+
+Both norms install a forward PRE-hook that recomputes the effective
+weight from auxiliary parameters before every forward — the same hook
+design as the reference; the recompute is a couple of fused reductions
+XLA folds into the surrounding graph.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op, to_tensor
+from ..layer.layers import Layer
+
+__all__ = [
+    "weight_norm",
+    "remove_weight_norm",
+    "spectral_norm",
+    "parameters_to_vector",
+    "vector_to_parameters",
+    "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+def _norm_except(w, dim):
+    """L2 norm over all axes except `dim` (keeps dims)."""
+    axes = tuple(i for i in range(len(w.shape)) if i != dim)
+    return run_op(
+        "norm_except",
+        lambda a: jnp.sqrt(jnp.sum(a * a, axis=axes, keepdims=True)),
+        [w])
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v / ||v|| (reference
+    weight_norm_hook.py). Adds <name>_g and <name>_v parameters."""
+    if dim is None:
+        dim = -1
+    w = getattr(layer, name)
+    ndim = len(w.shape)
+    if dim < 0:
+        dim += ndim
+    g = _norm_except(w, dim)
+    from ...framework.core import Parameter
+
+    gp = Parameter(g._value, trainable=True)
+    vp = Parameter(w._value, trainable=True)
+    del layer._parameters[name]
+    layer._parameters[name + "_g"] = gp
+    layer._parameters[name + "_v"] = vp
+    object.__setattr__(layer, name + "_g", gp)
+    object.__setattr__(layer, name + "_v", vp)
+
+    def compute():
+        vn = _norm_except(vp, dim)
+        eff = run_op("weight_norm_eff",
+                     lambda vv, gg, nn_: vv * (gg / jnp.maximum(nn_, 1e-12)),
+                     [vp, gp, vn])
+        object.__setattr__(layer, name, eff)
+
+    def hook(lyr, inputs):
+        compute()
+        return None
+
+    compute()
+    h = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hook = (h, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter (reference
+    weight_norm_hook.py remove_weight_norm)."""
+    h, nm, dim = layer._weight_norm_hook
+    h.remove()
+    from ...framework.core import Parameter
+
+    gp = layer._parameters.pop(nm + "_g")
+    vp = layer._parameters.pop(nm + "_v")
+    vn = _norm_except(vp, dim)
+    eff = run_op("weight_norm_eff",
+                 lambda vv, gg, nn_: vv * (gg / jnp.maximum(nn_, 1e-12)),
+                 [vp, gp, vn])
+    p = Parameter(eff._value, trainable=True)
+    layer._parameters[nm] = p
+    object.__setattr__(layer, nm, p)
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide layer.<name> by its largest singular value, estimated with
+    power iteration on persistent u/v buffers (reference
+    spectral_norm_hook.py; kernel spectral_norm op in ops.yaml)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    shape = [int(s) for s in w.shape]
+    h = shape[dim]
+    rest = int(np.prod(shape)) // h
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(h).astype("float32")
+    v0 = rng.standard_normal(rest).astype("float32")
+    u0 /= np.linalg.norm(u0) + eps
+    v0 /= np.linalg.norm(v0) + eps
+    layer.register_buffer(name + "_u", to_tensor(u0))
+    layer.register_buffer(name + "_v", to_tensor(v0))
+    from ...framework.core import Parameter
+
+    orig = Parameter(w._value, trainable=True)
+    del layer._parameters[name]
+    layer._parameters[name + "_orig"] = orig
+    object.__setattr__(layer, name + "_orig", orig)
+
+    def compute(update_iters):
+        ub = getattr(layer, name + "_u")
+        vb = getattr(layer, name + "_v")
+
+        def fn(wv, u, v):
+            wm = jnp.moveaxis(wv, dim, 0).reshape(h, rest)
+            for _ in range(update_iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return wv / sigma, u, v
+
+        eff, nu, nv = run_op("spectral_norm", fn, [orig, ub, vb])
+        ub._value = nu._value
+        vb._value = nv._value
+        object.__setattr__(layer, name, eff)
+
+    def hook(lyr, inputs):
+        compute(n_power_iterations if lyr.training else 0)
+        return None
+
+    compute(n_power_iterations)
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Concat flattened params (reference transform_parameters.py)."""
+    params = list(parameters)
+
+    def fn(*vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    return run_op("params_to_vector", fn, params)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Scatter a flat vector back into params (in-place)."""
+    params = list(parameters)
+    off = 0
+    v = np.asarray(vec._value if isinstance(vec, Tensor) else vec)
+    for p in params:
+        n = int(np.prod(p.shape))
+        p._value = jnp.asarray(v[off:off + n].reshape(tuple(p.shape)),
+                               p._value.dtype)
+        off += n
+    return params
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Clip total grad norm in place; returns the pre-clip norm
+    (reference clip_grad_norm_.py)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return to_tensor(np.float32(0.0))
+    # one fused device reduction + a single scalar read — per-step hot
+    # path must not pull every grad to host
+    if norm_type == float("inf"):
+        total_dev = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total_dev = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._value) ** norm_type) for g in grads])
+        ) ** (1.0 / norm_type)
+    total = float(total_dev)
+    if error_if_nonfinite and not np.isfinite(total):
+        raise RuntimeError(
+            f"grad norm is {total}; set error_if_nonfinite=False to skip")
+    coef = max_norm / (total + 1e-6)
+    if coef < 1.0:
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._value = p.grad._value * coef
+    return to_tensor(np.float32(total))
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp each grad element to [-clip_value, clip_value]."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)

@@ -44,6 +44,7 @@ __all__ = [
     "cpu_places",
     "cuda_places",
     "nn",
+    "gradients",
 ]
 
 Variable = Tensor  # the one-type design: static Variables ARE Tensors
@@ -206,6 +207,8 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 from ..jit import InputSpec  # noqa: E402  (one spec type, shared with jit)
+from . import control_flow  # noqa: E402
+from .control_flow import gradients  # noqa: E402
 
 
 class Executor:
@@ -354,6 +357,9 @@ class _StaticNN:
         layer = pnn.BatchNorm(int(x.shape[1]))
         _current[-1]._holders.append(layer)
         return layer(x)
+
+    cond = staticmethod(control_flow.cond)
+    while_loop = staticmethod(control_flow.while_loop)
 
 
 nn = _StaticNN()

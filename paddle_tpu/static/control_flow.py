@@ -1,0 +1,255 @@
+"""Static-graph control flow: cond / while_loop (+ static gradients).
+
+Reference surface: python/paddle/static/nn/control_flow.py (cond:723,
+while_loop:1313) and python/paddle/base/backward.py gradients.
+
+TPU formulation — no ConditionalBlock / While ops or sub-block descs
+(reference: paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc). A branch/body is TRACED ONCE by running its Python callable
+under a nested op recorder; the captured sub-trace replays inside a single
+``lax.cond`` / ``lax.while_loop`` recorded as ONE op of the enclosing
+program (and of the eager tape). XLA compiles real device-side control
+flow — both branches live in one program, the loop carry stays on-chip —
+which is what the reference's executor-level sub-block scheduling becomes
+on TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework.core import Tensor, run_op
+
+__all__ = ["cond", "while_loop", "gradients"]
+
+
+def _flatten(x):
+    return jax.tree_util.tree_flatten(
+        x, is_leaf=lambda v: isinstance(v, Tensor))
+
+
+class _SubTrace:
+    """One nested recording: ops + output + external tensor inputs."""
+
+    def __init__(self, fn, bound_ids=()):
+        self.ops = []
+        prev = _core._op_recorder
+        _core.set_op_recorder(self._record)
+        try:
+            self.out = fn()
+        finally:
+            _core.set_op_recorder(prev)
+        produced = set()
+        for _n, _f, entries, out_ids, _o in self.ops:
+            produced.update(out_ids)
+        self.externals = []
+        seen = set(bound_ids) | produced
+        for _n, _f, entries, _oi, _o in self.ops:
+            for kind, a, obj in entries:
+                if kind == "t" and a not in seen:
+                    self.externals.append(obj)
+                    seen.add(a)
+
+    def _record(self, name, fn, inputs, result):
+        entries = []
+        for i in inputs:
+            if isinstance(i, Tensor):
+                entries.append(("t", id(i), i))
+            else:
+                entries.append(("c", np.asarray(i), None))
+        outs = result if isinstance(result, (list, tuple)) else [result]
+        out_ids = [id(o) for o in outs if isinstance(o, Tensor)]
+        self.ops.append((name, fn, entries, out_ids,
+                         [o for o in outs if isinstance(o, Tensor)]))
+
+    def replay_into(self, env):
+        """Pure replay of the sub-trace over an id->value env (mutates)."""
+        for _name, fn, entries, out_ids, _outs in self.ops:
+            vals = []
+            for kind, a, obj in entries:
+                if kind == "c":
+                    vals.append(a)
+                else:
+                    v = env.get(a)
+                    vals.append(obj._value if v is None else v)
+            res = fn(*vals)
+            rl = res if isinstance(res, tuple) else [res]
+            for oid, v in zip(out_ids, rl):
+                env[oid] = v
+        return env
+
+    def leaf_value(self, env, t):
+        if isinstance(t, Tensor):
+            v = env.get(id(t))
+            return t._value if v is None else v
+        return t
+
+
+def _check_same_structure(t_leaves, f_leaves, t_tree, f_tree):
+    if t_tree != f_tree:
+        raise ValueError(
+            "true_fn and false_fn must return the same nest structure, "
+            f"got {t_tree} vs {f_tree}")
+    for a, b in zip(t_leaves, f_leaves):
+        at = isinstance(a, Tensor)
+        bt = isinstance(b, Tensor)
+        if at != bt:
+            raise ValueError("branch outputs mix Tensors and constants")
+        if at and (tuple(a.shape) != tuple(b.shape)
+                   or str(a.dtype) != str(b.dtype)):
+            raise ValueError(
+                f"branch output shape/dtype mismatch: "
+                f"{a.shape}/{a.dtype} vs {b.shape}/{b.dtype}")
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run true_fn() or false_fn() by device-side predicate (reference
+    control_flow.py:723). Both callables take no arguments and must return
+    matching nests of Tensors; both are traced at build time and compiled
+    into one ``lax.cond``.
+
+    Example (reference docstring, control_flow.py:723)::
+
+        a = paddle.full([1], 1.0)
+        b = paddle.full([1], 2.0)
+        out = paddle.static.nn.cond(a < b, lambda: a + b, lambda: a * b)
+    """
+    if true_fn is None:
+        raise ValueError("cond requires a true_fn")
+    tt = _SubTrace(true_fn)
+    ft = _SubTrace(false_fn) if false_fn is not None else None
+    if ft is None:
+        if tt.out is not None:
+            raise ValueError(
+                "cond: false_fn is None so true_fn must return None")
+        ft = _SubTrace(lambda: None)
+
+    t_leaves, t_tree = _flatten(tt.out)
+    f_leaves, f_tree = _flatten(ft.out)
+    _check_same_structure(t_leaves, f_leaves, t_tree, f_tree)
+    for a, b in zip(t_leaves, f_leaves):
+        if not isinstance(a, Tensor) and a is not b and a != b:
+            raise ValueError(
+                f"non-Tensor branch outputs must be equal, got {a} vs {b}")
+    tensor_slots = [i for i, a in enumerate(t_leaves)
+                    if isinstance(a, Tensor)]
+
+    ext, seen = [], set()
+    for t in tt.externals + ft.externals:
+        if id(t) not in seen:
+            ext.append(t)
+            seen.add(id(t))
+    ext_ids = [id(t) for t in ext]
+
+    def fn(pv, *ext_vals):
+        p = jnp.reshape(pv, ()).astype(bool)
+
+        def true_branch(ops_ext):
+            env = dict(zip(ext_ids, ops_ext))
+            tt.replay_into(env)
+            return tuple(tt.leaf_value(env, t_leaves[i])
+                         for i in tensor_slots)
+
+        def false_branch(ops_ext):
+            env = dict(zip(ext_ids, ops_ext))
+            ft.replay_into(env)
+            return tuple(ft.leaf_value(env, f_leaves[i])
+                         for i in tensor_slots)
+
+        return jax.lax.cond(p, true_branch, false_branch, tuple(ext_vals))
+
+    if not tensor_slots:
+        return tt.out  # both branches returned None / equal constants
+    outs = run_op("static_cond", fn, [pred] + ext)
+    outs = list(outs) if isinstance(outs, tuple) else [outs]
+    merged = list(t_leaves)
+    for slot, o in zip(tensor_slots, outs):
+        merged[slot] = o
+    return jax.tree_util.tree_unflatten(t_tree, merged)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """reference control_flow.py:1313. ``cond(*loop_vars) -> scalar bool
+    Tensor``; ``body(*loop_vars) -> new loop_vars`` with identical
+    shapes/dtypes. Traced once, compiled into one ``lax.while_loop``.
+
+    Example (reference docstring, control_flow.py:1313)::
+
+        i = paddle.full(shape=[1], fill_value=0, dtype='int64')
+        ten = paddle.full(shape=[1], fill_value=10, dtype='int64')
+        out = paddle.static.nn.while_loop(
+            lambda i: i < ten, lambda i: [i + 1], [i])
+    """
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    lv_leaves, lv_tree = _flatten(list(loop_vars))
+    if not all(isinstance(t, Tensor) for t in lv_leaves):
+        raise ValueError("loop_vars leaves must be Tensors")
+    lv_ids = [id(t) for t in lv_leaves]
+
+    ct = _SubTrace(lambda: cond(*loop_vars), bound_ids=lv_ids)
+    if not isinstance(ct.out, Tensor) or int(np.prod(ct.out.shape or [1])) != 1:
+        raise ValueError("cond must return a scalar (shape [] or [1]) Tensor")
+    bt = _SubTrace(lambda: body(*loop_vars), bound_ids=lv_ids)
+    b_out = bt.out if isinstance(bt.out, (list, tuple)) else [bt.out]
+    b_leaves, b_tree = _flatten(list(b_out))
+    if len(b_leaves) != len(lv_leaves):
+        raise ValueError(
+            f"body must return as many vars as loop_vars "
+            f"({len(b_leaves)} vs {len(lv_leaves)})")
+    for a, b in zip(lv_leaves, b_leaves):
+        if isinstance(b, Tensor) and (tuple(a.shape) != tuple(b.shape)
+                                      or str(a.dtype) != str(b.dtype)):
+            raise ValueError(
+                f"body output {b.shape}/{b.dtype} does not match loop var "
+                f"{a.shape}/{a.dtype}")
+
+    ext, seen = [], set(lv_ids)
+    for t in ct.externals + bt.externals:
+        if id(t) not in seen:
+            ext.append(t)
+            seen.add(id(t))
+    ext_ids = [id(t) for t in ext]
+    n = len(lv_leaves)
+
+    def fn(*vals):
+        lvs, exts = vals[:n], vals[n:]
+
+        def cond_f(carry):
+            env = dict(zip(lv_ids, carry))
+            env.update(zip(ext_ids, exts))
+            ct.replay_into(env)
+            return jnp.reshape(ct.leaf_value(env, ct.out), ()).astype(bool)
+
+        def body_f(carry):
+            env = dict(zip(lv_ids, carry))
+            env.update(zip(ext_ids, exts))
+            bt.replay_into(env)
+            return tuple(bt.leaf_value(env, b) for b in b_leaves)
+
+        return jax.lax.while_loop(cond_f, body_f, tuple(lvs))
+
+    outs = run_op("static_while", fn, list(lv_leaves) + ext)
+    outs = list(outs) if isinstance(outs, tuple) else [outs]
+    result = jax.tree_util.tree_unflatten(lv_tree, outs)
+    return result if isinstance(loop_vars, list) else tuple(result)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference python/paddle/base/backward.py gradients — appends the
+    backward computation to the current program and returns grad Variables.
+
+    Here the differentiable-grad path (autograd._grad_create_graph) runs
+    ONE grad_replay op; with a program recorder active that op is recorded
+    like any other, so Executor.run can fetch the returned grads with feeds
+    bound as usual."""
+    from ..autograd import grad as _grad
+
+    single = isinstance(inputs, Tensor)
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 create_graph=True, allow_unused=True)
+    return [outs] if single else list(outs)

@@ -1,6 +1,6 @@
 """Functional tensor op surface (reference: python/paddle/tensor/)."""
 
-from . import creation, extras, linalg, logic, manipulation, math, random, search, stat
+from . import creation, extras, linalg, logic, manipulation, math, random, search, stat, tail
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
@@ -10,6 +10,7 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from .tail import *  # noqa: F401,F403
 
 __all__ = (
     list(creation.__all__)
@@ -21,6 +22,7 @@ __all__ = (
     + list(stat.__all__)
     + list(random.__all__)
     + list(extras.__all__)
+    + list(tail.__all__)
 )
 
 # generated `<op>_` in-place variants over the assembled namespace

@@ -45,6 +45,7 @@ __all__ = [
     "cov",
     "corrcoef",
     "einsum",
+    "svdvals",
 ]
 
 
@@ -416,6 +417,13 @@ def _lowrank(v, q, key, niter=2):
     b = jnp.swapaxes(qmat, -2, -1) @ v
     u, s, vt = jnp.linalg.svd(b, full_matrices=False)
     return qmat @ u, s, jnp.swapaxes(vt, -2, -1)
+
+
+def svdvals(x, name=None):
+    """Singular values only (reference tensor/linalg.py svdvals; ops.yaml
+    svdvals)."""
+    return run_op("svdvals",
+                  lambda a: jnp.linalg.svd(a, compute_uv=False), [x])
 
 
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):

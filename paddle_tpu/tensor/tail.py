@@ -1,0 +1,183 @@
+"""Long-tail tensor ops closing the ops.yaml audit gaps (round 4).
+
+References: python/paddle/tensor/creation.py (tril_indices:2480,
+triu_indices, complex), tensor/manipulation.py (fill_diagonal_,
+fill_diagonal_tensor, reduce_as), tensor/math.py (clip_by_norm),
+nn kernels edit_distance / standard_gamma.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, register_tensor_method, run_op, to_tensor
+
+__all__ = [
+    "tril_indices",
+    "triu_indices",
+    "complex",
+    "fill_diagonal_",
+    "fill_diagonal_tensor",
+    "fill_diagonal_tensor_",
+    "reduce_as",
+    "edit_distance",
+    "clip_by_norm",
+    "standard_gamma",
+]
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    """reference tensor/creation.py tril_indices (ops.yaml tril_indices)."""
+    if col is None:
+        col = row
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return to_tensor(np.stack([r, c]).astype(np.int64))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    """reference tensor/creation.py triu_indices."""
+    if col is None:
+        col = row
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return to_tensor(np.stack([r, c]).astype(np.int64))
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    """reference tensor/creation.py complex (ops.yaml complex)."""
+    return run_op("complex", jax.lax.complex, [real, imag])
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place diagonal fill (reference tensor/manipulation.py
+    fill_diagonal_; ops.yaml fill_diagonal)."""
+    def fn(a):
+        if a.ndim == 2 and wrap and a.shape[0] > a.shape[1]:
+            # wrap: the diagonal restarts after each W+1 flat elements
+            # (NumPy fill_diagonal wrap semantics; offset must be 0)
+            H, W = a.shape
+            flat = np.arange(0, H * W, W + 1)
+            return a.reshape(-1).at[flat].set(value).reshape(H, W)
+        n = min(a.shape[-2], a.shape[-1])
+        idx = np.arange(n)
+        r = idx - min(offset, 0)
+        c = idx + max(offset, 0)
+        ok = (r < a.shape[-2]) & (c < a.shape[-1])
+        r, c = r[ok], c[ok]
+        return a.at[..., r, c].set(value)
+
+    out = run_op("fill_diagonal", fn, [x])
+    if isinstance(x, Tensor):
+        return x._inplace_update(out)
+    return out
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write y along the (dim1, dim2) diagonal of x (reference
+    tensor/manipulation.py fill_diagonal_tensor; ops.yaml
+    fill_diagonal_tensor)."""
+    def fn(a, v):
+        d1, d2 = dim1 % a.ndim, dim2 % a.ndim
+        perm = [d for d in range(a.ndim) if d not in (d1, d2)] + [d1, d2]
+        inv = np.argsort(perm)
+        t = jnp.transpose(a, perm)
+        n = min(t.shape[-2], t.shape[-1])
+        idx = np.arange(n)
+        r = idx - min(offset, 0)
+        c = idx + max(offset, 0)
+        ok = (r < t.shape[-2]) & (c < t.shape[-1])
+        r, c = r[ok], c[ok]
+        t = t.at[..., r, c].set(v[..., : r.shape[0]])
+        return jnp.transpose(t, inv)
+
+    return run_op("fill_diagonal_tensor", fn, [x, y])
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    out = fill_diagonal_tensor(x, y, offset, dim1, dim2)
+    if isinstance(x, Tensor):
+        return x._inplace_update(out)
+    return out
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference tensor/math.py reduce_as;
+    ops.yaml reduce_as)."""
+    tgt_shape = tuple(int(s) for s in target.shape)
+
+    def fn(a):
+        extra = a.ndim - len(tgt_shape)
+        axes = list(range(extra))
+        for i, s in enumerate(tgt_shape):
+            if a.shape[extra + i] != s:
+                axes.append(extra + i)
+        out = a.sum(axis=tuple(axes), keepdims=False) if axes else a
+        return out.reshape(tgt_shape)
+
+    return run_op("reduce_as", fn, [x])
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per sequence pair (reference ops.yaml
+    edit_distance; kernel edit_distance_kernel.cu). Host-side dynamic
+    programming — the op is a metric, not a training path.
+
+    Returns (distance [B,1], sequence_num [1])."""
+    a = np.asarray(input._value if isinstance(input, Tensor) else input)
+    b = np.asarray(label._value if isinstance(label, Tensor) else label)
+    il = (np.asarray(input_length._value).reshape(-1)
+          if input_length is not None else None)
+    ll = (np.asarray(label_length._value).reshape(-1)
+          if label_length is not None else None)
+    ig = set(ignored_tokens or [])
+    B = a.shape[0]
+    out = np.zeros((B, 1), np.float32)
+    for i in range(B):
+        s1 = a[i][: int(il[i])] if il is not None else a[i]
+        s2 = b[i][: int(ll[i])] if ll is not None else b[i]
+        s1 = [t for t in s1.tolist() if t not in ig]
+        s2 = [t for t in s2.tolist() if t not in ig]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for cc in range(1, n + 1):
+                dp[cc] = min(prev[cc] + 1, dp[cc - 1] + 1,
+                             prev[cc - 1] + (s1[r - 1] != s2[cc - 1]))
+        d = float(dp[n])
+        if normalized:
+            d = d / max(n, 1)
+        out[i, 0] = d
+    return to_tensor(out), to_tensor(np.asarray([B], np.int64))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x so ||x||_2 <= max_norm (reference ops.yaml clip_by_norm;
+    python/paddle/nn/clip.py)."""
+    def fn(a):
+        norm = jnp.sqrt(jnp.maximum(jnp.sum(a * a), 1e-12))
+        scale = jnp.minimum(max_norm / norm, 1.0)
+        return a * scale
+
+    return run_op("clip_by_norm", fn, [x])
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, 1) elementwise (reference ops.yaml
+    standard_gamma; paddle.standard_gamma)."""
+    from ..framework import random as rnd
+
+    def fn(a, key):
+        return jax.random.gamma(key, a, dtype=a.dtype)
+
+    return run_op("standard_gamma", fn, [x, rnd.rng_tensor()])
+
+
+for _name in ("fill_diagonal_", "fill_diagonal_tensor",
+              "fill_diagonal_tensor_", "reduce_as", "clip_by_norm"):
+    if not hasattr(Tensor, _name):
+        register_tensor_method(_name, globals()[_name])

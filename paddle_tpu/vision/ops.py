@@ -1,0 +1,946 @@
+"""Detection / vision operators (reference: python/paddle/vision/ops.py).
+
+TPU design split:
+
+* Shape-STATIC ops (roi_align, roi_pool, psroi_pool, box_coder, prior_box,
+  yolo_box, yolo_loss, deform_conv2d) run as single jnp programs through
+  run_op — bilinear sampling becomes vectorized gathers, deformable conv
+  becomes sampled-im2col + one MXU matmul, exactly the layout XLA tiles
+  well. The reference's CUDA kernels (deformable_conv_kernel.cu,
+  roi_align_kernel.cu, yolo_box_op.cu) have no other residue here.
+* Data-DEPENDENT-shape ops (nms, matrix_nms, generate_proposals,
+  distribute_fpn_proposals) return variable-length results; XLA requires
+  static shapes, so these run host-side on NumPy — matching how detection
+  post-processing deploys in practice. Scores/boxes are device arrays right
+  up to the final suppression pass.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, to_tensor
+from ..nn.layer.layers import Layer
+from ..nn.layer.container import Sequential
+
+__all__ = [
+    "yolo_loss",
+    "yolo_box",
+    "prior_box",
+    "box_coder",
+    "deform_conv2d",
+    "DeformConv2D",
+    "distribute_fpn_proposals",
+    "read_file",
+    "decode_jpeg",
+    "psroi_pool",
+    "PSRoIPool",
+    "roi_pool",
+    "RoIPool",
+    "roi_align",
+    "RoIAlign",
+    "ConvNormActivation",
+    "nms",
+    "matrix_nms",
+    "generate_proposals",
+]
+
+
+def _np(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+# --------------------------------------------------------------------------- #
+# box utilities
+# --------------------------------------------------------------------------- #
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (reference ops.py:584; kernel
+    phi/kernels/gpu/box_coder.cu)."""
+    norm = 0.0 if box_normalized else 1.0
+
+    if code_type == "encode_center_size":
+        def fn(pb, tb, pbv=None):
+            pw = pb[:, 2] - pb[:, 0] + norm
+            ph = pb[:, 3] - pb[:, 1] + norm
+            px = pb[:, 0] + pw * 0.5
+            py = pb[:, 1] + ph * 0.5
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            # [T, P] broadcast: every target against every prior
+            ox = (tx[:, None] - px[None, :]) / pw[None, :]
+            oy = (ty[:, None] - py[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)
+            if pbv is not None:
+                v = pbv if pbv.ndim == 2 else jnp.broadcast_to(
+                    pbv, (pb.shape[0], 4))
+                out = out / v[None, :, :]
+            return out
+
+        if isinstance(prior_box_var, (list, tuple)):
+            pbv = jnp.asarray(prior_box_var, jnp.float32)
+            return run_op("box_coder_enc",
+                          lambda pb, tb: fn(pb, tb, pbv),
+                          [prior_box, target_box])
+        if prior_box_var is None:
+            return run_op("box_coder_enc", fn, [prior_box, target_box])
+        return run_op("box_coder_enc",
+                      lambda pb, tb, v: fn(pb, tb, v),
+                      [prior_box, target_box, prior_box_var])
+
+    if code_type != "decode_center_size":
+        raise ValueError(f"unknown code_type {code_type!r}")
+
+    def dec(pb, tb, pbv=None):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        # tb: [N, M, 4]; priors broadcast along `axis`
+        if axis == 0:
+            pw_, ph_, px_, py_ = (a[None, :] for a in (pw, ph, px, py))
+        else:
+            pw_, ph_, px_, py_ = (a[:, None] for a in (pw, ph, px, py))
+        t = tb
+        if pbv is not None:
+            v = pbv if pbv.ndim == 2 else jnp.broadcast_to(
+                pbv, (pb.shape[0], 4))
+            v = v[None, :, :] if axis == 0 else v[:, None, :]
+            t = t * v
+        ox = t[..., 0] * pw_ + px_
+        oy = t[..., 1] * ph_ + py_
+        ow = jnp.exp(t[..., 2]) * pw_
+        oh = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                          ox + ow * 0.5 - norm, oy + oh * 0.5 - norm],
+                         axis=-1)
+
+    if isinstance(prior_box_var, (list, tuple)):
+        pbv = jnp.asarray(prior_box_var, jnp.float32)
+        return run_op("box_coder_dec", lambda pb, tb: dec(pb, tb, pbv),
+                      [prior_box, target_box])
+    if prior_box_var is None:
+        return run_op("box_coder_dec", dec, [prior_box, target_box])
+    return run_op("box_coder_dec", lambda pb, tb, v: dec(pb, tb, v),
+                  [prior_box, target_box, prior_box_var])
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference ops.py:438). Returns (boxes [H,W,P,4],
+    variances [H,W,P,4])."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []  # (w, h) per prior, reference kernel ordering
+    for ms in min_sizes:
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = float(max_sizes[min_sizes.index(ms)] if isinstance(
+                    min_sizes, list) else max_sizes[0])
+                s = np.sqrt(ms * mx)
+                whs.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = float(max_sizes[list(min_sizes).index(ms)])
+                s = np.sqrt(ms * mx)
+                whs.append((s, s))
+    whs = np.asarray(whs, np.float32)  # [P, 2]
+    P = whs.shape[0]
+
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # [H, W]
+    boxes = np.empty((H, W, P, 4), np.float32)
+    boxes[..., 0] = (cxg[..., None] - whs[None, None, :, 0] / 2) / img_w
+    boxes[..., 1] = (cyg[..., None] - whs[None, None, :, 1] / 2) / img_h
+    boxes[..., 2] = (cxg[..., None] + whs[None, None, :, 0] / 2) / img_w
+    boxes[..., 3] = (cyg[..., None] + whs[None, None, :, 1] / 2) / img_h
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          (H, W, P, 4)).copy()
+    return to_tensor(boxes), to_tensor(var)
+
+
+# --------------------------------------------------------------------------- #
+# RoI ops — vectorized bilinear gathers (static shapes)
+# --------------------------------------------------------------------------- #
+
+def _rois_to_batch_index(boxes_num, n_rois):
+    bn = _np(boxes_num).astype(np.int64)
+    idx = np.repeat(np.arange(len(bn)), bn)
+    if idx.shape[0] != n_rois:
+        raise ValueError(
+            f"boxes_num sums to {idx.shape[0]} but boxes has {n_rois} rows")
+    return jnp.asarray(idx)
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C, H, W]; y/x broadcastable index grids -> sampled values
+    [C, *grid] with zero padding outside."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def gather(yi, xi):
+        yi_c = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+        xi_c = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+        v = feat[:, yi_c, xi_c]
+        ok = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1))
+        return v * ok.astype(feat.dtype)
+
+    return (gather(y0, x0) * (wy0 * wx0)
+            + gather(y0, x1) * (wy0 * wx1)
+            + gather(y1, x0) * (wy1 * wx0)
+            + gather(y1, x1) * (wy1 * wx1))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference ops.py:1705; kernel roi_align_kernel.cu). One
+    vmap over rois; each roi is a bilinear gather grid."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    batch_idx = _rois_to_batch_index(boxes_num, int(boxes.shape[0]))
+
+    def fn(xv, bv):
+        off = 0.5 if aligned else 0.0
+
+        def one(roi, bi):
+            x1, y1, x2, y2 = (roi * spatial_scale - off)
+            rw = x2 - x1
+            rh = y2 - y1
+            if not aligned:
+                rw = jnp.maximum(rw, 1.0)
+                rh = jnp.maximum(rh, 1.0)
+            bh = rh / ph
+            bw = rw / pw
+            iy = (jnp.arange(ph)[:, None, None, None]
+                  * bh + y1 + (jnp.arange(sr)[None, None, :, None] + 0.5)
+                  * bh / sr)
+            ix = (jnp.arange(pw)[None, :, None, None]
+                  * bw + x1 + (jnp.arange(sr)[None, None, None, :] + 0.5)
+                  * bw / sr)
+            iy = jnp.broadcast_to(iy, (ph, pw, sr, sr))
+            ix = jnp.broadcast_to(ix, (ph, pw, sr, sr))
+            vals = _bilinear_sample(xv[bi], iy, ix)  # [C, ph, pw, sr, sr]
+            return vals.mean(axis=(-1, -2))
+
+        return jax.vmap(one)(bv, batch_idx)
+
+    return run_op("roi_align", fn, [x, boxes])
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool max-pool variant (reference ops.py:1572)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = _rois_to_batch_index(boxes_num, int(boxes.shape[0]))
+
+    def fn(xv, bv):
+        H, W = xv.shape[-2], xv.shape[-1]
+
+        def one(roi, bi):
+            x1 = jnp.round(roi[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            ys = jnp.arange(H)
+            xs = jnp.arange(W)
+            feat = xv[bi]
+            out = jnp.full((xv.shape[1], ph, pw), -jnp.inf, xv.dtype)
+            # bin index of every pixel; scatter-max per bin
+            by = jnp.clip(((ys - y1) * ph) // rh, 0, ph - 1)
+            bx = jnp.clip(((xs - x1) * pw) // rw, 0, pw - 1)
+            in_y = (ys >= y1) & (ys <= y2)
+            in_x = (xs >= x1) & (xs <= x2)
+            mask = in_y[:, None] & in_x[None, :]
+            vals = jnp.where(mask[None], feat, -jnp.inf)
+            flat_bin = by[:, None] * pw + bx[None, :]
+            out = jax.ops.segment_max(
+                vals.reshape(vals.shape[0], -1).T, flat_bin.reshape(-1),
+                num_segments=ph * pw)  # [ph*pw, C]
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+            return out.T.reshape(xv.shape[1], ph, pw)
+
+        return jax.vmap(one)(bv, batch_idx)
+
+    return run_op("roi_pool", fn, [x, boxes])
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pool (reference ops.py:1441). Channel
+    dim must be C = out_c * ph * pw; bin (i,j) reads channel slice
+    out_c*(i*pw+j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    C = int(x.shape[1])
+    if C % (ph * pw) != 0:
+        raise ValueError(
+            f"psroi_pool: input channels {C} not divisible by "
+            f"{ph}*{pw}")
+    out_c = C // (ph * pw)
+    batch_idx = _rois_to_batch_index(boxes_num, int(boxes.shape[0]))
+
+    def fn(xv, bv):
+        H, W = xv.shape[-2], xv.shape[-1]
+
+        def one(roi, bi):
+            x1 = roi[0] * spatial_scale
+            y1 = roi[1] * spatial_scale
+            x2 = roi[2] * spatial_scale
+            y2 = roi[3] * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1)
+            rw = jnp.maximum(x2 - x1, 0.1)
+            bh, bw = rh / ph, rw / pw
+            feat = xv[bi].reshape(ph * pw, out_c, H, W)
+            ys = jnp.arange(H, dtype=xv.dtype) + 0.5
+            xs = jnp.arange(W, dtype=xv.dtype) + 0.5
+
+            def bin_val(b):
+                i, j = b // pw, b % pw
+                y_lo, y_hi = y1 + i * bh, y1 + (i + 1) * bh
+                x_lo, x_hi = x1 + j * bw, x1 + (j + 1) * bw
+                m = ((ys[:, None] >= y_lo) & (ys[:, None] < y_hi)
+                     & (xs[None, :] >= x_lo) & (xs[None, :] < x_hi))
+                m = m.astype(xv.dtype)
+                denom = jnp.maximum(m.sum(), 1.0)
+                return (feat[b] * m[None]).sum(axis=(-1, -2)) / denom
+
+            vals = jax.vmap(bin_val)(jnp.arange(ph * pw))  # [ph*pw, out_c]
+            return vals.T.reshape(out_c, ph, pw)
+
+        return jax.vmap(one)(bv, batch_idx)
+
+    return run_op("psroi_pool", fn, [x, boxes])
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+# --------------------------------------------------------------------------- #
+# deformable convolution — sampled-im2col + one MXU matmul
+# --------------------------------------------------------------------------- #
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference ops.py:766; kernel
+    deformable_conv_kernel.cu). Each output location bilinearly samples its
+    kh*kw receptive field at learned offsets; samples form an im2col matrix
+    contracted against the weights in ONE matmul — the MXU does the work,
+    the gathers are the only irregular part."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    C_in = int(x.shape[1])
+    use_mask = mask is not None
+
+    def fn(xv, ov, wv, *rest):
+        mv = rest[0] if use_mask else None
+        bv = rest[-1] if (len(rest) == 2 or (len(rest) == 1 and not use_mask)) else None
+        B, C, H, W = xv.shape
+        out_h = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        out_w = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        # base sampling grid [out_h, out_w, kh, kw]
+        oy = jnp.arange(out_h) * s[0] - p[0]
+        ox = jnp.arange(out_w) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        # offsets: [B, 2*dg*kh*kw, out_h, out_w] (y then x per pair)
+        off = ov.reshape(B, deformable_groups, kh * kw, 2, out_h, out_w)
+        off_y = off[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(
+            B, deformable_groups, out_h, out_w, kh, kw)
+        off_x = off[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(
+            B, deformable_groups, out_h, out_w, kh, kw)
+        cg = C // deformable_groups
+
+        def sample_batch(feat, offy, offx, m):
+            # feat [C, H, W]; offy/offx [dg, out_h, out_w, kh, kw]
+            def per_dg(f, oy_, ox_):
+                yy = base_y + oy_
+                xx = base_x + ox_
+                return _bilinear_sample(f, yy, xx)  # [cg, oh, ow, kh, kw]
+
+            cols = jax.vmap(per_dg)(
+                feat.reshape(deformable_groups, cg, H, W), offy, offx)
+            if m is not None:
+                # v2 modulation mask: [dg*kh*kw, oh, ow] -> per-dg scale
+                mm = m.reshape(deformable_groups, kh, kw, out_h, out_w) \
+                    .transpose(0, 3, 4, 1, 2)  # [dg, oh, ow, kh, kw]
+                cols = cols * mm[:, None]
+            return cols.reshape(C, out_h, out_w, kh, kw)
+
+        if use_mask:
+            cols = jax.vmap(sample_batch)(xv, off_y, off_x, mv)
+        else:
+            cols = jax.vmap(lambda f, a, b: sample_batch(f, a, b, None))(
+                xv, off_y, off_x)
+        # cols [B, C, oh, ow, kh, kw] -> matmul with weight [O, C/g, kh, kw]
+        O = wv.shape[0]
+        cpg = C // groups
+        opg = O // groups
+        cols_g = cols.reshape(B, groups, cpg, out_h, out_w, kh, kw)
+        w_g = wv.reshape(groups, opg, cpg, kh, kw)
+        out = jnp.einsum("bgchwyx,gocyx->bgohw", cols_g, w_g)
+        out = out.reshape(B, O, out_h, out_w)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    ins = [x, offset, weight]
+    if use_mask:
+        ins.append(mask)
+    if bias is not None:
+        ins.append(bias)
+    return run_op("deform_conv2d", fn, ins)
+
+
+class DeformConv2D(Layer):
+    """reference ops.py:973."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        import math
+
+        from ..nn import initializer as I
+
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr, default_initializer=I.Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+# --------------------------------------------------------------------------- #
+# YOLO
+# --------------------------------------------------------------------------- #
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head to boxes+scores (reference ops.py:277; kernel
+    yolo_box_op.cu). Returns (boxes [B,H*W*A,4], scores [B,H*W*A,C])."""
+    anchors = list(anchors)
+    na = len(anchors) // 2
+    an = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+
+    def fn(xv, img):
+        B, _, H, W = xv.shape
+        if iou_aware:
+            ioup = jax.nn.sigmoid(xv[:, :na].reshape(B, na, 1, H, W))
+            xv = xv[:, na:]
+        v = xv.reshape(B, na, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=xv.dtype)
+        gy = jnp.arange(H, dtype=xv.dtype)
+        bx = ((jax.nn.sigmoid(v[:, :, 0]) - 0.5) * scale_x_y + 0.5
+              + gx[None, None, None, :]) / W
+        by = ((jax.nn.sigmoid(v[:, :, 1]) - 0.5) * scale_x_y + 0.5
+              + gy[None, None, :, None]) / H
+        input_size = downsample_ratio * H
+        bw = jnp.exp(v[:, :, 2]) * an[None, :, 0, None, None] / input_size
+        bh = jnp.exp(v[:, :, 3]) * an[None, :, 1, None, None] / input_size
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) \
+                * ioup[:, :, 0] ** iou_aware_factor
+        probs = jax.nn.sigmoid(v[:, :, 5:]) * conf[:, :, None]
+        keep = (conf >= conf_thresh).astype(xv.dtype)
+        img_h = img[:, 0].astype(xv.dtype)
+        img_w = img[:, 1].astype(xv.dtype)
+        x1 = (bx - bw / 2) * img_w[:, None, None, None]
+        y1 = (by - bh / 2) * img_h[:, None, None, None]
+        x2 = (bx + bw / 2) * img_w[:, None, None, None]
+        y2 = (by + bh / 2) * img_h[:, None, None, None]
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w[:, None, None, None] - 1)
+            y1 = jnp.clip(y1, 0, img_h[:, None, None, None] - 1)
+            x2 = jnp.clip(x2, 0, img_w[:, None, None, None] - 1)
+            y2 = jnp.clip(y2, 0, img_h[:, None, None, None] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) \
+            * keep[..., None]
+        scores = probs * keep[:, :, None]
+        boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(B, -1, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+            B, na * H * W, class_num)
+        return boxes, scores
+
+    return run_op("yolo_box", fn, [x, img_size])
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference ops.py:69; kernel yolov3_loss).
+    Per-image loss: coordinate SSE (gt-assigned cells) + objectness BCE
+    with ignore mask + class BCE."""
+    anchors = list(anchors)
+    anchor_mask = list(anchor_mask)
+    na = len(anchor_mask)
+    all_an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_an = jnp.asarray(all_an[anchor_mask])
+
+    def fn(xv, gb, gl, *rest):
+        gs = rest[0] if gt_score is not None else None
+        B, _, H, W = xv.shape
+        input_size = downsample_ratio * H
+        v = xv.reshape(B, na, 5 + class_num, H, W)
+        px = jax.nn.sigmoid(v[:, :, 0])
+        py = jax.nn.sigmoid(v[:, :, 1])
+        pw_ = v[:, :, 2]
+        ph_ = v[:, :, 3]
+        obj_logit = v[:, :, 4]
+        cls_logit = v[:, :, 5:]
+
+        # decode predicted boxes (normalized) for the ignore mask
+        gx = jnp.arange(W, dtype=xv.dtype)
+        gy = jnp.arange(H, dtype=xv.dtype)
+        bx = (px + gx[None, None, None, :]) / W
+        by = (py + gy[None, None, :, None]) / H
+        bw = jnp.exp(pw_) * mask_an[None, :, 0, None, None] / input_size
+        bh = jnp.exp(ph_) * mask_an[None, :, 1, None, None] / input_size
+
+        def iou_xywh(b1, b2):
+            b1x1, b1x2 = b1[..., 0] - b1[..., 2] / 2, b1[..., 0] + b1[..., 2] / 2
+            b1y1, b1y2 = b1[..., 1] - b1[..., 3] / 2, b1[..., 1] + b1[..., 3] / 2
+            b2x1, b2x2 = b2[..., 0] - b2[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2
+            b2y1, b2y2 = b2[..., 1] - b2[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2
+            ix = jnp.maximum(jnp.minimum(b1x2, b2x2)
+                             - jnp.maximum(b1x1, b2x1), 0)
+            iy = jnp.maximum(jnp.minimum(b1y2, b2y2)
+                             - jnp.maximum(b1y1, b2y1), 0)
+            inter = ix * iy
+            a1 = (b1x2 - b1x1) * (b1y2 - b1y1)
+            a2 = (b2x2 - b2x1) * (b2y2 - b2y1)
+            return inter / jnp.maximum(a1 + a2 - inter, 1e-10)
+
+        pred = jnp.stack([bx, by, bw, bh], axis=-1)  # [B,na,H,W,4]
+        # best IoU of each prediction vs any gt of its image
+        ious = iou_xywh(pred[:, :, :, :, None, :],
+                        gb[:, None, None, None, :, :])  # [B,na,H,W,G]
+        best = ious.max(axis=-1)
+        ignore = (best > ignore_thresh).astype(xv.dtype)
+
+        # gt assignment: gt g -> cell (gi, gj), best anchor by wh IoU
+        G = gb.shape[1]
+        gwh = gb[..., 2:4]  # normalized
+        an_n = jnp.asarray(all_an) / input_size  # [A, 2]
+        inter = (jnp.minimum(gwh[:, :, None, 0], an_n[None, None, :, 0])
+                 * jnp.minimum(gwh[:, :, None, 1], an_n[None, None, :, 1]))
+        union = (gwh[:, :, 0:1] * gwh[:, :, 1:2]
+                 + an_n[None, None, :, 0] * an_n[None, None, :, 1] - inter)
+        an_iou = inter / jnp.maximum(union, 1e-10)
+        best_an = an_iou.argmax(-1)  # [B, G] index into ALL anchors
+        # map to this head's slot (or -1)
+        slot = jnp.full_like(best_an, -1)
+        for s_i, a_i in enumerate(anchor_mask):
+            slot = jnp.where(best_an == a_i, s_i, slot)
+        valid = (gwh[..., 0] > 0) & (slot >= 0)
+        gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        tx = gb[..., 0] * W - gi
+        ty = gb[..., 1] * H - gj
+        tw = jnp.log(jnp.maximum(
+            gwh[..., 0] * input_size
+            / jnp.maximum(jnp.asarray(all_an)[best_an][..., 0], 1e-10),
+            1e-10))
+        th = jnp.log(jnp.maximum(
+            gwh[..., 1] * input_size
+            / jnp.maximum(jnp.asarray(all_an)[best_an][..., 1], 1e-10),
+            1e-10))
+        score = gs if gs is not None else jnp.ones(gb.shape[:2], xv.dtype)
+        wgt = (2.0 - gwh[..., 0] * gwh[..., 1]) * score \
+            * valid.astype(xv.dtype)
+
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, G))
+        slot_c = jnp.clip(slot, 0, na - 1)
+
+        def at(pred_map):
+            return pred_map[bidx, slot_c, gj, gi]
+
+        def bce(logit, label):
+            return jax.nn.softplus(logit) - logit * label
+
+        loss_xy = (bce(v[:, :, 0][bidx, slot_c, gj, gi], tx)
+                   + bce(v[:, :, 1][bidx, slot_c, gj, gi], ty)) * wgt
+        loss_wh = (jnp.abs(at(pw_) - tw) + jnp.abs(at(ph_) - th)) * wgt
+        # objectness: positives at gt cells, negatives elsewhere not ignored
+        obj_pos = jnp.zeros((B, na, H, W), xv.dtype)
+        obj_pos = obj_pos.at[bidx, slot_c, gj, gi].max(
+            valid.astype(xv.dtype) * score)
+        noobj = (1.0 - (obj_pos > 0)) * (1.0 - ignore)
+        loss_obj = (bce(obj_logit, jnp.ones_like(obj_logit)) * obj_pos
+                    + bce(obj_logit, jnp.zeros_like(obj_logit)) * noobj)
+        smooth = 1.0 / max(class_num, 1) if (use_label_smooth
+                                             and class_num > 1) else 0.0
+        tcls = jax.nn.one_hot(gl, class_num, dtype=xv.dtype)
+        tcls = tcls * (1.0 - smooth) + smooth / 2.0
+        cls_at = cls_logit.transpose(0, 1, 3, 4, 2)[bidx, slot_c, gj, gi]
+        loss_cls = (bce(cls_at, tcls).sum(-1)) * valid.astype(xv.dtype) \
+            * score
+        # all four terms reduce to a per-image [B] loss
+        return (loss_xy.sum(1) + loss_wh.sum(1)
+                + loss_obj.sum(axis=(1, 2, 3)) + loss_cls.sum(1))
+
+    ins = [x, gt_box, gt_label]
+    if gt_score is not None:
+        ins.append(gt_score)
+    return run_op("yolo_loss", fn, ins)
+
+
+# --------------------------------------------------------------------------- #
+# NMS family — host-side (data-dependent output shapes)
+# --------------------------------------------------------------------------- #
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    ix = np.maximum(np.minimum(x2[:, None], x2[None]) -
+                    np.maximum(x1[:, None], x1[None]), 0)
+    iy = np.maximum(np.minimum(y2[:, None], y2[None]) -
+                    np.maximum(y1[:, None], y1[None]), 0)
+    inter = ix * iy
+    return inter / np.maximum(area[:, None] + area[None] - inter, 1e-10)
+
+
+def _nms_np(boxes, scores, iou_threshold):
+    order = np.argsort(-scores)
+    iou = _iou_matrix(boxes)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = False
+    return np.asarray(keep, np.int64)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference ops.py:1934). Host-side: variable-length output.
+    Returns kept indices sorted by score."""
+    b = _np(boxes).astype(np.float64)
+    if scores is None:
+        s = np.arange(len(b), 0, -1, dtype=np.float64)
+    else:
+        s = _np(scores).astype(np.float64)
+    if category_idxs is None:
+        keep = _nms_np(b, s, iou_threshold)
+    else:
+        cat = _np(category_idxs)
+        keep_all = []
+        for c in categories:
+            idx = np.nonzero(cat == c)[0]
+            if idx.size == 0:
+                continue
+            k = _nms_np(b[idx], s[idx], iou_threshold)
+            keep_all.append(idx[k])
+        keep = np.concatenate(keep_all) if keep_all else np.empty(0, np.int64)
+        keep = keep[np.argsort(-s[keep], kind="stable")]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return to_tensor(keep.astype(np.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference ops.py:2358, SOLOv2). Host-side decay-based
+    suppression. Returns (out [N,6], rois_num?, index?)."""
+    bb = _np(bboxes).astype(np.float64)   # [B, M, 4]
+    sc = _np(scores).astype(np.float64)   # [B, C, M]
+    B, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for bi in range(B):
+        dets, det_idx = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[bi, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            sel = sel[np.argsort(-s[sel])][:nms_top_k]
+            boxes_c = bb[bi, sel]
+            s_c = s[sel]
+            iou = _iou_matrix(boxes_c)
+            n = len(sel)
+            decay = np.ones(n)
+            iou_u = np.triu(iou, 1)
+            max_iou = iou_u.max(axis=0) if n > 1 else np.zeros(n)
+            for j in range(n):
+                ious_j = iou_u[:j, j]
+                if ious_j.size == 0:
+                    continue
+                if use_gaussian:
+                    d = np.exp(-(ious_j ** 2 - max_iou[:j] ** 2)
+                               / gaussian_sigma).min()
+                else:
+                    d = ((1 - ious_j) / np.maximum(1 - max_iou[:j],
+                                                   1e-10)).min()
+                decay[j] = d
+            new_s = s_c * decay
+            ok = new_s > post_threshold
+            for j in np.nonzero(ok)[0]:
+                dets.append([c, new_s[j], *boxes_c[j]])
+                det_idx.append(bi * M + sel[j])
+        dets = np.asarray(dets, np.float64).reshape(-1, 6)
+        det_idx = np.asarray(det_idx, np.int64)
+        if len(dets) > keep_top_k:
+            order = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, det_idx = dets[order], det_idx[order]
+        else:
+            order = np.argsort(-dets[:, 1]) if len(dets) else np.empty(0, int)
+            dets, det_idx = dets[order], det_idx[order]
+        outs.append(dets)
+        idxs.append(det_idx)
+        nums.append(len(dets))
+    out = to_tensor(np.concatenate(outs).astype(np.float32)
+                    if outs else np.zeros((0, 6), np.float32))
+    res = [out]
+    if return_rois_num:
+        res.append(to_tensor(np.asarray(nums, np.int32)))
+    if return_index:
+        res.append(to_tensor(np.concatenate(idxs)
+                             if idxs else np.empty(0, np.int64)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference ops.py:2106). Decode on device,
+    filter+NMS on host."""
+    sc = _np(scores)          # [B, A, H, W]
+    bd = _np(bbox_deltas)     # [B, A*4, H, W]
+    ims = _np(img_size)       # [B, 2]
+    an = _np(anchors).reshape(-1, 4)   # [H*W*A, 4]
+    vr = _np(variances).reshape(-1, 4)
+    B = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    rois, roi_probs, nums = [], [], []
+    for bi in range(B):
+        s = sc[bi].transpose(1, 2, 0).reshape(-1)
+        d = bd[bi].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], vr[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw / 2
+        ay = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + ax
+        cy = v[:, 1] * d[:, 1] * ah + ay
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], 1)
+        H_img, W_img = ims[bi, 0], ims[bi, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W_img - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H_img - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        ok = (ws >= min_size) & (hs >= min_size)
+        boxes, s = boxes[ok], s[ok]
+        keep = _nms_np(boxes, s, nms_thresh)[:post_nms_top_n]
+        rois.append(boxes[keep])
+        roi_probs.append(s[keep])
+        nums.append(len(keep))
+    out_rois = to_tensor(np.concatenate(rois).astype(np.float32))
+    out_probs = to_tensor(np.concatenate(roi_probs).astype(np.float32))
+    if return_rois_num:
+        return out_rois, out_probs, to_tensor(np.asarray(nums, np.int32))
+    return out_rois, out_probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (reference ops.py:1175)."""
+    rois = _np(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-10))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    n_levels = max_level - min_level + 1
+    multi_rois, restore = [], np.empty(len(rois), np.int64)
+    rois_num_per = []
+    pos = 0
+    for li in range(n_levels):
+        idx = np.nonzero(lvl == min_level + li)[0]
+        multi_rois.append(to_tensor(rois[idx].astype(np.float32)))
+        restore[idx] = np.arange(pos, pos + len(idx))
+        pos += len(idx)
+        rois_num_per.append(len(idx))
+    restore_ind = to_tensor(restore.reshape(-1, 1))
+    if rois_num is not None:
+        rn = _np(rois_num)
+        starts = np.concatenate([[0], np.cumsum(rn)])
+        per_level_nums = []
+        for li in range(n_levels):
+            cnt = np.zeros(len(rn), np.int32)
+            for bi in range(len(rn)):
+                seg = lvl[starts[bi]:starts[bi + 1]]
+                cnt[bi] = int((seg == min_level + li).sum())
+            per_level_nums.append(to_tensor(cnt))
+        return multi_rois, restore_ind, per_level_nums
+    return multi_rois, restore_ind
+
+
+# --------------------------------------------------------------------------- #
+# file IO
+# --------------------------------------------------------------------------- #
+
+def read_file(filename, name=None):
+    """Raw file bytes as uint8 tensor (reference ops.py:1345)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return to_tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode (reference ops.py:1388). Host-side via Pillow when
+    available; this environment has no GPU nvjpeg analog."""
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "decode_jpeg requires Pillow for host-side decoding") from e
+    import io
+
+    img = Image.open(io.BytesIO(_np(x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(arr)
+
+
+class ConvNormActivation(Sequential):
+    """Conv2D + Norm + Activation block (reference ops.py:1877)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=None,
+                 activation_layer=None, dilation=1, bias=None):
+        from .. import nn as pnn
+
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is None:
+            norm_layer = pnn.BatchNorm2D
+        if activation_layer is None:
+            activation_layer = pnn.ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [pnn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                             padding, dilation=dilation, groups=groups,
+                             bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

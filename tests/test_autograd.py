@@ -254,3 +254,61 @@ class TestReviewRegressions:
         h.retain_grads()
         (h * 3).sum().backward()
         np.testing.assert_allclose(h.grad.numpy(), [3.0])
+
+
+class TestDoubleGrad:
+    """create_graph=True: grads carry tape nodes (VERDICT r3 missing #6;
+    reference: test/legacy_test/test_imperative_double_grad.py)."""
+
+    def test_second_order_parity_with_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = tensor([1.0, 2.0, 3.0])
+        w = np.array([0.5, -1.0, 2.0], np.float32)
+        y = (x * x * x * tensor(w)).sum()
+        (gx,) = paddle.autograd.grad(y, [x], create_graph=True)
+        assert not gx.stop_gradient
+        gx.sum().backward()
+        ref = jax.grad(lambda xv: jax.grad(
+            lambda a: (a ** 3 * jnp.asarray(w)).sum())(xv).sum())(
+            jnp.array([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(x.grad.numpy(), np.asarray(ref), rtol=1e-5)
+
+    def test_gradient_penalty_reaches_weights(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(2, 1)
+        x = tensor([[1.0, 2.0]])
+        out = paddle.tanh(lin(x)).sum()
+        (g,) = paddle.autograd.grad(out, [x], create_graph=True)
+        (g * g).sum().backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(np.asarray(lin.weight.grad.numpy())).all()
+
+    def test_grad_wrt_intermediate(self):
+        a = tensor([2.0])
+        b = a * 3.0
+        (gb,) = paddle.autograd.grad((b * b).sum(), [b], create_graph=True)
+        np.testing.assert_allclose(gb.numpy(), [12.0], rtol=1e-6)
+
+    def test_multi_input_second_order(self):
+        p = tensor([1.0])
+        q = tensor([2.0])
+        r = (p * p * q).sum()
+        gp, gq = paddle.autograd.grad(r, [p, q], create_graph=True)
+        np.testing.assert_allclose(gp.numpy(), [4.0])
+        np.testing.assert_allclose(gq.numpy(), [1.0])
+        (gp * gq).sum().backward()  # loss = 2p^3 q
+        np.testing.assert_allclose(p.grad.numpy(), [12.0], rtol=1e-5)
+        np.testing.assert_allclose(q.grad.numpy(), [2.0], rtol=1e-5)
+
+    def test_unused_input_raises_unless_allowed(self):
+        x = tensor([1.0])
+        z = tensor([1.0])
+        y = (x * x).sum()
+        with pytest.raises(RuntimeError):
+            paddle.autograd.grad(y, [z], create_graph=True)
+        gs = paddle.autograd.grad(y, [x, z], create_graph=True,
+                                  allow_unused=True)
+        assert gs[1] is None
+        np.testing.assert_allclose(gs[0].numpy(), [2.0])

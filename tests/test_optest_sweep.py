@@ -419,7 +419,265 @@ REGISTRY = [
                            np.where(x < -0.25, x + 0.25, 0)), [NZ]),
     _sp("hardshrink", lambda x: F.hardshrink(x, threshold=0.25),
         lambda x: np.where(np.abs(x) > 0.25, x, 0.0), [NZ]),
+    # ---- round-4 growth: activations -------------------------------- #
+    _sp("hardsigmoid", F.hardsigmoid,
+        lambda x: np.clip(x / 6.0 + 0.5, 0, 1), [NZ]),
+    _sp("selu", F.selu,
+        lambda x: 1.0507009873554805 * np.where(
+            x > 0, x, 1.6732632423543772 * np.expm1(x)), [NZ]),
+    _sp("prelu", F.prelu,
+        lambda x, w: np.where(x > 0, x, w.reshape(1, -1, 1) * x),
+        [S(shape=(2, 4, 3)), S(shape=(4,), low=0.1, high=0.5)]),
+    _sp("glu", F.glu,
+        lambda x: x[:, :2] / (1 + np.exp(-x[:, 2:])),
+        [S(shape=(3, 4))]),
+    _sp("maxout", lambda x: F.maxout(x, 2),
+        lambda x: x.reshape(3, 2, 2, 4).max(2), [S(shape=(3, 4, 4))]),
+    _sp("rrelu_eval", lambda x: F.rrelu(x, training=False),
+        lambda x: np.where(x >= 0, x, x * (1 / 8 + 1 / 3) / 2), [NZ]),
+    # ---- binary / comparison / bitwise ------------------------------- #
+    _sp("floor_divide", paddle.floor_divide, np.floor_divide,
+        [S(), S(low=0.5, high=2.0)], check_grad=False),
+    _sp("mod", paddle.mod, np.mod, [S(), S(low=0.5, high=2.0)],
+        check_grad=False),
+    _sp("gcd", paddle.gcd, np.gcd, [INT8, INT8], check_grad=False),
+    _sp("lcm", paddle.lcm, np.lcm, [INT8, INT8], check_grad=False),
+    _sp("not_equal", paddle.not_equal, np.not_equal, [INT8, INT8],
+        check_grad=False, check_bf16=False),
+    _sp("greater_equal", paddle.greater_equal, np.greater_equal,
+        [INT8, INT8], check_grad=False, check_bf16=False),
+    _sp("less_equal", paddle.less_equal, np.less_equal, [INT8, INT8],
+        check_grad=False, check_bf16=False),
+    _sp("logical_or", paddle.logical_or,
+        lambda a, b: np.logical_or(a != 0, b != 0),
+        [S(), S()], check_grad=False, check_bf16=False),
+    _sp("logical_xor", paddle.logical_xor,
+        lambda a, b: np.logical_xor(a != 0, b != 0),
+        [S(), S()], check_grad=False, check_bf16=False),
+    _sp("bitwise_or", paddle.bitwise_or, np.bitwise_or, [INT8, INT8],
+        check_grad=False, check_bf16=False),
+    _sp("bitwise_not", paddle.bitwise_not, np.bitwise_not, [INT8],
+        check_grad=False, check_bf16=False),
+    _sp("bitwise_left_shift", paddle.bitwise_left_shift, np.left_shift,
+        [INT8, S(dtype="int", low=0, high=3)],
+        check_grad=False, check_bf16=False),
+    _sp("bitwise_right_shift", paddle.bitwise_right_shift, np.right_shift,
+        [INT8, S(dtype="int", low=0, high=3)],
+        check_grad=False, check_bf16=False),
+    _sp("isclose", paddle.isclose, np.isclose, [S(), S()],
+        check_grad=False, check_bf16=False),
+    _sp("equal_all", paddle.equal_all,
+        lambda a, b: np.asarray(np.array_equal(a, b)), [INT8, INT8],
+        check_grad=False, check_bf16=False),
+    _sp("signbit", paddle.signbit, np.signbit, [NZ], check_grad=False,
+        check_bf16=False),
+    _sp("isneginf", paddle.isneginf, np.isneginf, [NZ],
+        check_grad=False, check_bf16=False),
+    _sp("isposinf", paddle.isposinf, np.isposinf, [NZ],
+        check_grad=False, check_bf16=False),
+    # ---- reductions / scans ------------------------------------------ #
+    _sp("amax", paddle.amax, np.max, [S()]),
+    _sp("amin", paddle.amin, np.min, [S()]),
+    _sp("nansum", paddle.nansum, np.nansum, [S()]),
+    _sp("cummax", lambda x: paddle.cummax(x, axis=0)[0],
+        lambda x: np.maximum.accumulate(x, axis=0), [S()]),
+    _sp("cummin", lambda x: paddle.cummin(x, axis=0)[0],
+        lambda x: np.minimum.accumulate(x, axis=0), [S()]),
+    _sp("p_norm_c", lambda x: paddle._C_ops.p_norm(x, 2.0, -1),
+        lambda x: np.linalg.norm(x, axis=-1), [S()]),
+    _sp("frobenius_norm_c", paddle._C_ops.frobenius_norm,
+        lambda x: np.sqrt((x * x).sum()), [S()]),
+    _sp("l1_norm_c", paddle._C_ops.l1_norm,
+        lambda x: np.abs(x).sum(), [NZ]),
+    _sp("squared_l2_norm_c", paddle._C_ops.squared_l2_norm,
+        lambda x: (x * x).sum().reshape(1), [S()]),
+    # ---- special functions ------------------------------------------- #
+    _sp("polygamma", lambda x: paddle.polygamma(x, 1),
+        lambda x: __import__("scipy.special",
+                             fromlist=["polygamma"]).polygamma(1, x),
+        [POS]),
+    _sp("erfinv", paddle.erfinv,
+        lambda x: __import__("scipy.special", fromlist=["erfinv"]).erfinv(x),
+        [UNIT]),
+    _sp("i0e", paddle.i0e,
+        lambda x: __import__("scipy.special", fromlist=["i0e"]).i0e(x),
+        [POS]),
+    _sp("i1", paddle.i1,
+        lambda x: __import__("scipy.special", fromlist=["i1"]).i1(x),
+        [POS]),
+    _sp("i1e", paddle.i1e,
+        lambda x: __import__("scipy.special", fromlist=["i1e"]).i1e(x),
+        [POS]),
+    _sp("gammaln", paddle.gammaln,
+        lambda x: __import__("scipy.special", fromlist=["gammaln"]).gammaln(x),
+        [POS]),
+    # ---- linalg tail ------------------------------------------------- #
+    _sp("multi_dot", lambda a, b: paddle.linalg.multi_dot([a, b]),
+        lambda a, b: a @ b, [S(shape=(3, 4)), S(shape=(4, 2))]),
+    _sp("svdvals", paddle.linalg.svdvals,
+        lambda x: np.linalg.svd(x, compute_uv=False),
+        [S(shape=(4, 3))], check_bf16=False, check_grad=False),
+    _sp("matrix_exp", paddle.linalg.matrix_exp,
+        lambda x: __import__("scipy.linalg",
+                             fromlist=["expm"]).expm(x),
+        [S(shape=(3, 3), low=-0.3, high=0.3)], check_bf16=False,
+        check_grad=False),
+    _sp("cov", lambda x: paddle.linalg.cov(x),
+        lambda x: np.cov(x), [S(shape=(3, 8))], check_bf16=False),
+    _sp("corrcoef", lambda x: paddle.linalg.corrcoef(x),
+        lambda x: np.corrcoef(x), [S(shape=(3, 8))], check_bf16=False,
+        check_grad=False),
+    # ---- manipulation tail ------------------------------------------- #
+    _sp("unstack", lambda x: paddle.unstack(x, axis=0)[0],
+        lambda x: x[0], [S()]),
+    _sp("tensor_split", lambda x: paddle.tensor_split(x, 2, axis=1)[0],
+        lambda x: x[:, :2], [S()]),
+    _sp("hsplit", lambda x: paddle.hsplit(x, 2)[1],
+        lambda x: x[:, 2:], [S()]),
+    _sp("vsplit", lambda x: paddle.vsplit(x, 3)[0],
+        lambda x: x[:1], [S()]),
+    _sp("hstack", lambda a, b: paddle.hstack([a, b]),
+        lambda a, b: np.hstack([a, b]),
+        [S(), S()]),
+    _sp("vstack", lambda a, b: paddle.vstack([a, b]),
+        lambda a, b: np.vstack([a, b]),
+        [S(), S()]),
+    _sp("dstack", lambda a, b: paddle.dstack([a, b]),
+        lambda a, b: np.dstack([a, b]),
+        [S(), S()]),
+    _sp("atleast_1d", lambda x: paddle.atleast_1d(x),
+        lambda x: np.atleast_1d(x), [S()]),
+    _sp("unflatten", lambda x: paddle.unflatten(x, 1, [2, 2]),
+        lambda x: x.reshape(3, 2, 2), [S()]),
+    _sp("as_strided", lambda x: paddle.as_strided(x, [2, 2], [4, 1]),
+        lambda x: np.lib.stride_tricks.as_strided(
+            x, (2, 2), (x.strides[0], x.strides[1])), [S()],
+        check_grad=False, check_jit=False),
+    _sp("diagflat", paddle.diagflat,
+        lambda x: np.diagflat(x), [S(shape=(4,))]),
+    _sp("conj_real", paddle.conj, np.conj, [S()]),
+    _sp("real", paddle.real, np.real, [S()], check_grad=False),
+    _sp("take", lambda x: paddle.take(x, paddle.to_tensor(
+        np.array([0, 3, 5], np.int64))),
+        lambda x: x.reshape(-1)[[0, 3, 5]], [S()]),
+    _sp("index_add",
+        lambda x, v: paddle.index_add(
+            x, paddle.to_tensor(np.array([0, 2], np.int64)), 0, v),
+        lambda x, v: _index_add_np(x, v),
+        [S(shape=(3, 4)), S(shape=(2, 4))]),
+    _sp("crop", lambda x: paddle.crop(x, shape=[2, 2], offsets=[1, 1]),
+        lambda x: x[1:3, 1:3], [S()]),
+    _sp("strided_slice",
+        lambda x: paddle.strided_slice(x, [0], [0], [3], [2]),
+        lambda x: x[0:3:2], [S()]),
+    _sp("multiplex",
+        lambda a, b: paddle.multiplex(
+            [a, b], paddle.to_tensor(np.array([[0], [1], [0]], np.int32))),
+        lambda a, b: np.stack([a[0], b[1], a[2]]), [S(), S()]),
+    # ---- dynamic-shape ops (eager only) ------------------------------ #
+    _sp("unique", lambda x: paddle.unique(x)[0] if isinstance(
+        paddle.unique(x), (tuple, list)) else paddle.unique(x),
+        lambda x: np.unique(x), [INT8], check_grad=False,
+        check_jit=False, check_bf16=False),
+    _sp("nonzero", lambda x: paddle.nonzero(x),
+        lambda x: np.stack(np.nonzero(x), axis=1), [INT8],
+        check_grad=False, check_jit=False, check_bf16=False),
+    _sp("unique_consecutive",
+        lambda x: paddle.unique_consecutive(x)[0] if isinstance(
+            paddle.unique_consecutive(x), (tuple, list))
+        else paddle.unique_consecutive(x),
+        lambda x: x[np.concatenate([[True], x[1:] != x[:-1]])],
+        [S(shape=(8,), dtype="int", low=0, high=3)], check_grad=False,
+        check_jit=False, check_bf16=False),
+    _sp("bincount", lambda x: paddle.bincount(x, minlength=8),
+        lambda x: np.bincount(x, minlength=8),
+        [S(shape=(12,), dtype="int", low=0, high=8)], check_grad=False,
+        check_bf16=False, check_jit=False),
+    # ---- round-4 new ops --------------------------------------------- #
+    _sp("reduce_as", lambda x: paddle.reduce_as(
+        x, paddle.to_tensor(np.zeros((4,), np.float32))),
+        lambda x: x.sum(0), [S()]),
+    _sp("clip_by_norm", lambda x: paddle.clip_by_norm(x, 1.0),
+        lambda x: x * min(1.0, 1.0 / np.linalg.norm(x)), [S()]),
+    _sp("hinge_loss_c", paddle._C_ops.hinge_loss,
+        lambda lg, lb: np.maximum(0.0, 1.0 - lb * lg),
+        [NZ, S(low=0.5, high=1.5)]),
+    _sp("affine_channel_c",
+        lambda x, s, b: paddle._C_ops.affine_channel(x, s, b),
+        lambda x, s, b: x * s.reshape(1, -1, 1) + b.reshape(1, -1, 1),
+        [S(shape=(2, 3, 4)), S(shape=(3,)), S(shape=(3,))]),
+    _sp("segment_sum",
+        lambda x: paddle.geometric.segment_sum(x, paddle.to_tensor(
+            np.array([0, 0, 1], np.int32))),
+        lambda x: np.stack([x[0] + x[1], x[2]]), [S(shape=(3, 4))],
+        check_jit=False),
+    _sp("segment_mean",
+        lambda x: paddle.geometric.segment_mean(x, paddle.to_tensor(
+            np.array([0, 0, 1], np.int32))),
+        lambda x: np.stack([(x[0] + x[1]) / 2, x[2]]), [S(shape=(3, 4))],
+        check_jit=False),
+    _sp("segment_max",
+        lambda x: paddle.geometric.segment_max(x, paddle.to_tensor(
+            np.array([0, 0, 1], np.int32))),
+        lambda x: np.stack([np.maximum(x[0], x[1]), x[2]]),
+        [S(shape=(3, 4))], check_jit=False),
+    _sp("segment_min",
+        lambda x: paddle.geometric.segment_min(x, paddle.to_tensor(
+            np.array([0, 0, 1], np.int32))),
+        lambda x: np.stack([np.minimum(x[0], x[1]), x[2]]),
+        [S(shape=(3, 4))], check_jit=False),
+    _sp("send_u_recv",
+        lambda x: paddle.geometric.send_u_recv(
+            x, paddle.to_tensor(np.array([0, 1, 2, 0], np.int32)),
+            paddle.to_tensor(np.array([1, 2, 1, 0], np.int32)), "sum"),
+        lambda x: np.stack([x[0], x[0] + x[2], x[1]]), [S(shape=(3, 4))],
+        check_jit=False),
+    _sp("send_uv",
+        lambda x: paddle.geometric.send_uv(
+            x, x, paddle.to_tensor(np.array([0, 1], np.int32)),
+            paddle.to_tensor(np.array([1, 2], np.int32)), "mul"),
+        lambda x: np.stack([x[0] * x[1], x[1] * x[2]]), [S(shape=(3, 4))]),
+    _sp("softmax_mask_fuse",
+        lambda x, m: paddle.incubate.softmax_mask_fuse(x, m * 100.0),
+        lambda x, m: _softmax_np(x + m * 100.0),
+        [S(shape=(1, 2, 3, 4)), S(shape=(1, 1, 3, 4), low=-1, high=0)]),
+    _sp("softmax_mask_fuse_ut",
+        paddle.incubate.softmax_mask_fuse_upper_triangle,
+        lambda x: _softmax_np(np.where(
+            np.tril(np.ones((4, 4), bool)), x, -np.inf)),
+        [S(shape=(1, 2, 4, 4))]),
+    _sp("lp_pool2d", lambda x: F.lp_pool2d(x, 2.0, 2, 2),
+        lambda x: np.sqrt(
+            (x ** 2).reshape(1, 1, 2, 2, 2, 2).transpose(
+                0, 1, 2, 4, 3, 5).reshape(1, 1, 2, 2, 4).sum(-1)),
+        [S(shape=(1, 1, 4, 4), low=0.2, high=2.0)]),
+    _sp("weight_dequant_roundtrip",
+        lambda x: paddle.nn.quant.weight_dequantize(
+            *paddle.nn.quant.weight_quantize(x), out_dtype="float32"),
+        lambda x: x, [S(shape=(8, 4))], rtol=2e-2, atol=2e-2,
+        check_grad=False, check_jit=False, check_bf16=False),
+    _sp("mean_all_c", paddle._C_ops.mean_all, np.mean, [S()]),
+    _sp("complex_abs",
+        lambda a, b: paddle.abs(paddle.complex(a, b)),
+        lambda a, b: np.abs(a + 1j * b), [NZ, NZ], check_grad=False,
+        check_bf16=False),
+    _sp("tanh_shrink_c", paddle._C_ops.tanh_shrink,
+        lambda x: x - np.tanh(x), [S()]),
+    _sp("logsigmoid_c", paddle._C_ops.logsigmoid,
+        lambda x: -np.log1p(np.exp(-x)), [S()]),
+    _sp("box_clip_c",
+        lambda b: paddle._C_ops.box_clip(
+            b, paddle.to_tensor(np.array([10.0, 10.0], np.float32))),
+        lambda b: np.clip(b, 0, 9), [S(shape=(3, 4), low=-2, high=12)],
+        check_grad=False),
 ]
+
+
+def _index_add_np(x, v):
+    out = x.copy()
+    out[0] += v[0]
+    out[2] += v[1]
+    return out
 
 _IDS = [s.name for s in REGISTRY]
 assert len(_IDS) == len(set(_IDS)), "duplicate registry ids"
@@ -432,7 +690,7 @@ def test_op_sweep(spec):
 
 def test_registry_breadth():
     """The sweep must stay seeded across the Tensor-method surface."""
-    assert len(REGISTRY) >= 150
+    assert len(REGISTRY) >= 250
     with_grad = [s for s in REGISTRY if s.check_grad]
     assert len(with_grad) >= 100
 

@@ -1,0 +1,129 @@
+"""Static control flow: cond / while_loop / gradients (reference:
+python/paddle/static/nn/control_flow.py:723,1313, base/backward.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+class TestCond:
+    def test_reference_docstring_example(self):
+        a = paddle.full([1], 1.0)
+        b = paddle.full([1], 2.0)
+        out = static.nn.cond(a < b, lambda: a + b, lambda: a * b)
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        out = static.nn.cond(a > b, lambda: a + b, lambda: a * b)
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+    def test_nest_outputs(self):
+        a = paddle.full([2], 1.0)
+        r = static.nn.cond(a.sum() > 0,
+                           lambda: (a + 1, [a * 2, a * 3]),
+                           lambda: (a - 1, [a * 4, a * 5]))
+        y, (p, q) = r
+        np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(q.numpy(), [3.0, 3.0])
+
+    def test_mismatched_branches_raise(self):
+        a = paddle.full([2], 1.0)
+        with pytest.raises(ValueError):
+            static.nn.cond(a.sum() > 0, lambda: a,
+                           lambda: paddle.full([3], 1.0))
+
+    def test_in_program_with_feeds(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            y = static.nn.fc(x, 3, activation="relu")
+            z = static.nn.cond(y.sum() < 1e9, lambda: y * 2.0,
+                               lambda: y - 1.0)
+        exe = static.Executor()
+        fx = np.random.default_rng(0).standard_normal((5, 4)).astype("float32")
+        (zv,) = exe.run(prog, feed={"x": fx}, fetch_list=[z])
+        assert zv.shape == (5, 3)
+
+    def test_device_side_predicate_in_program(self):
+        """The branch taken depends on the FED value, proving lax.cond
+        compiled into the program (not a baked build-time branch)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [1], "float32")
+            z = static.nn.cond(x.sum() > 0, lambda: x * 10.0,
+                               lambda: x * 100.0)
+        exe = static.Executor()
+        (a,) = exe.run(prog, feed={"x": np.array([2.0], "float32")},
+                       fetch_list=[z])
+        (b,) = exe.run(prog, feed={"x": np.array([-2.0], "float32")},
+                       fetch_list=[z])
+        np.testing.assert_allclose(a, [20.0])
+        np.testing.assert_allclose(b, [-200.0])
+
+
+class TestWhileLoop:
+    def test_reference_docstring_example(self):
+        i = paddle.full(shape=[1], fill_value=0, dtype="int32")
+        ten = paddle.full(shape=[1], fill_value=10, dtype="int32")
+        (out,) = static.nn.while_loop(lambda i: i < ten,
+                                      lambda i: [i + 1], [i])
+        np.testing.assert_allclose(out.numpy(), [10])
+
+    def test_multi_var(self):
+        i = paddle.full([1], 0.0)
+        acc = paddle.full([1], 0.0)
+        iN, accN = static.nn.while_loop(
+            lambda i, acc: i < 5.0, lambda i, acc: [i + 1.0, acc + i],
+            [i, acc])
+        np.testing.assert_allclose(accN.numpy(), [10.0])
+
+    def test_fed_trip_count_in_program(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            n = static.data("n", [1], "float32")
+            i0 = paddle.full([1], 0.0)
+            a0 = paddle.full([1], 0.0)
+            _, accN = static.nn.while_loop(
+                lambda i, a: i < n, lambda i, a: [i + 1.0, a + i], [i0, a0])
+        exe = static.Executor()
+        (v5,) = exe.run(prog, feed={"n": np.array([5.0], "float32")},
+                        fetch_list=[accN])
+        (v3,) = exe.run(prog, feed={"n": np.array([3.0], "float32")},
+                        fetch_list=[accN])
+        np.testing.assert_allclose(v5, [10.0])
+        np.testing.assert_allclose(v3, [3.0])
+
+    def test_bad_body_raises(self):
+        i = paddle.full([1], 0.0)
+        with pytest.raises(ValueError):
+            static.nn.while_loop(lambda i: i < 3.0,
+                                 lambda i: [paddle.full([2], 0.0)], [i])
+        with pytest.raises(ValueError):
+            static.nn.while_loop(lambda i: i < 3.0, lambda i: [i + 1], [])
+
+
+class TestStaticGradients:
+    def test_gradients_fetchable(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 2], "float32")
+            x.stop_gradient = False
+            y = (x * x).sum()
+            (gx,) = static.gradients(y, x)
+        feed = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+        (gv,) = static.Executor().run(prog, feed={"x": feed},
+                                      fetch_list=[gx])
+        np.testing.assert_allclose(gv, 2 * feed)
+
+    def test_gradients_through_param(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3, 4], "float32")
+            x.stop_gradient = False
+            y = static.nn.fc(x, 2)
+            (gx,) = static.gradients(y.sum(), x)
+        feed = np.ones((3, 4), "float32")
+        (gv,) = static.Executor().run(prog, feed={"x": feed},
+                                      fetch_list=[gx])
+        w = np.asarray(prog.all_parameters()[0].numpy())
+        np.testing.assert_allclose(gv, np.tile(w.sum(1), (3, 1)), rtol=1e-5)
