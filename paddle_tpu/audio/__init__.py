@@ -13,3 +13,5 @@ __all__ = ["functional", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]
 
 from . import datasets  # noqa: E402
+from . import backends  # noqa: E402
+from .backends import load, save, info  # noqa: E402

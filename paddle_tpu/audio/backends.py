@@ -1,0 +1,80 @@
+"""Audio file IO (reference: python/paddle/audio/backends/ — wave_backend
+load/save/info over the soundfile/wave libraries). Host-side scipy/wave IO;
+waveforms land as float32 arrays ready for `to_tensor`."""
+
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+
+__all__ = ["load", "save", "info", "AudioInfo"]
+
+
+class AudioInfo:
+    """reference backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Read a wav file -> (Tensor [C, T] (channels_first) float32 in
+    [-1, 1], sample_rate) (reference wave_backend.load)."""
+    from scipy.io import wavfile
+
+    sr, data = wavfile.read(filepath)
+    if data.ndim == 1:
+        data = data[:, None]
+    data = data[frame_offset: None if num_frames < 0
+                else frame_offset + num_frames]
+    if normalize:
+        if data.dtype.kind == "i":
+            data = data.astype(np.float32) / np.iinfo(data.dtype).max
+        elif data.dtype.kind == "u":
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32)
+    arr = data.T if channels_first else data
+    return to_tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Write a float waveform Tensor/[C,T] array as 16-bit PCM wav
+    (reference wave_backend.save)."""
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None]
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype("<i2")
+    with wave.open(str(filepath), "wb") as w:
+        w.setnchannels(pcm.shape[1])
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.tobytes())
+
+
+def info(filepath):
+    """Header-only probe (reference wave_backend.info)."""
+    with wave.open(str(filepath), "rb") as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=8 * w.getsampwidth())

@@ -45,6 +45,8 @@ __all__ = [
     "cuda_places",
     "nn",
     "gradients",
+    "Print",
+    "Assert",
 ]
 
 Variable = Tensor  # the one-type design: static Variables ARE Tensors
@@ -208,7 +210,7 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 from ..jit import InputSpec  # noqa: E402  (one spec type, shared with jit)
 from . import control_flow  # noqa: E402
-from .control_flow import gradients  # noqa: E402
+from .control_flow import Assert, Print, gradients  # noqa: E402
 
 
 class Executor:
@@ -360,6 +362,7 @@ class _StaticNN:
 
     cond = staticmethod(control_flow.cond)
     while_loop = staticmethod(control_flow.while_loop)
+    py_func = staticmethod(control_flow.py_func)
 
 
 nn = _StaticNN()

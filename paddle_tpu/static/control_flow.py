@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from ..framework import core as _core
 from ..framework.core import Tensor, run_op
 
-__all__ = ["cond", "while_loop", "gradients"]
+__all__ = ["cond", "while_loop", "gradients", "Print", "Assert", "py_func"]
 
 
 def _flatten(x):
@@ -253,3 +253,110 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     outs = _grad(targets, inputs, grad_outputs=target_gradients,
                  create_graph=True, allow_unused=True)
     return [outs] if single else list(outs)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both", name=None):
+    """Staged print: emits at RUN time, inside compiled programs too
+    (reference control_flow.py:2215 Print op). jax.debug.print is the TPU
+    lowering — the payload streams back from the device per execution,
+    which is exactly the reference Print op's runtime-side-effect
+    semantics (a trace-time Python print would fire once)."""
+    counter = [0]
+    # under a program recorder the op ALSO executes eagerly once at build
+    # time on placeholder zeros — that execution must not print
+    skip_build = [_core._op_recorder is not None]
+
+    def fn(v):
+        if skip_build[0]:
+            skip_build[0] = False
+            return v
+        if first_n < 0 or counter[0] < first_n:
+            counter[0] += 1
+            jax.debug.print((message + " {x}") if message else "{x}", x=v)
+        return v
+
+    return run_op("static_print", fn, [input])
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """Staged assert: checks at RUN time inside compiled programs
+    (reference control_flow.py:59). jax.debug.callback raises
+    AssertionError host-side when the predicate is False."""
+    datas = list(data) if data is not None else []
+    # the build-time eager execution sees placeholder zeros; only REPLAYS
+    # (Executor.run / jit) may fire the check
+    skip_build = [_core._op_recorder is not None]
+
+    def fn(c, *vals):
+        def check(cv, *dv):
+            if not bool(np.asarray(cv).reshape(-1).all()):
+                payload = "; ".join(
+                    np.array2string(np.asarray(d).reshape(-1)[:summarize])
+                    for d in dv)
+                raise AssertionError(
+                    f"static.Assert failed{': ' + payload if payload else ''}")
+
+        if skip_build[0]:
+            skip_build[0] = False
+            return c
+        jax.debug.callback(check, c, *vals)
+        return c
+
+    return run_op("static_assert", fn, [cond] + datas)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-side Python op inside a program (reference static/nn/common.py
+    py_func). jax.pure_callback is the TPU mechanism: the callable runs on
+    host at execution time with materialized arrays; `out` supplies the
+    result aval(s). backward_func, when given, becomes the custom VJP."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(int(s) for s in o.shape),
+                                   np.dtype(str(o.numpy().dtype)))
+              for o in outs]
+    single = not isinstance(out, (list, tuple))
+
+    def host(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        rl = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                for r, s in zip(rl, shapes)]
+
+    if backward_func is None:
+        def fn(*vals):
+            res = jax.pure_callback(host, shapes, *vals)
+            return res[0] if single else tuple(res)
+
+        return run_op("py_func", fn, list(xs))
+
+    bwd_shapes = [jax.ShapeDtypeStruct(tuple(int(s) for s in t.shape),
+                                       np.dtype(str(t.numpy().dtype)))
+                  for t in xs]
+
+    @jax.custom_vjp
+    def core(*vals):
+        res = jax.pure_callback(host, shapes, *vals)
+        return res[0] if single else tuple(res)
+
+    def core_fwd(*vals):
+        return core(*vals), vals
+
+    def core_bwd(vals, ct):
+        cts = [ct] if single else list(ct)
+
+        def bhost(*args):
+            n = len(vals)
+            res = backward_func(*[np.asarray(a) for a in args])
+            rl = res if isinstance(res, (list, tuple)) else [res]
+            return [np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                    for r, s in zip(rl, bwd_shapes)]
+
+        gs = jax.pure_callback(bhost, bwd_shapes, *vals, *cts)
+        return tuple(gs)
+
+    core.defvjp(core_fwd, core_bwd)
+    return run_op("py_func", lambda *v: core(*v), list(xs))
