@@ -55,18 +55,30 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
 
 def save(filepath, src, sample_rate, channels_first=True,
          encoding="PCM_16", bits_per_sample=16):
-    """Write a float waveform Tensor/[C,T] array as 16-bit PCM wav
-    (reference wave_backend.save)."""
+    """Write a float waveform as PCM wav (reference wave_backend.save).
+    Supports 16- and 32-bit signed PCM; rejects other encodings rather
+    than silently down-converting."""
+    if encoding not in ("PCM_16", "PCM_32") \
+            or bits_per_sample not in (16, 32) \
+            or (encoding == "PCM_16") != (bits_per_sample == 16):
+        raise ValueError(
+            f"unsupported encoding {encoding}/{bits_per_sample}; "
+            "supported: PCM_16/16, PCM_32/32")
     arr = np.asarray(src._value if isinstance(src, Tensor) else src)
     if arr.ndim == 1:
-        arr = arr[None]
-    if channels_first:
-        arr = arr.T  # -> [T, C]
-    pcm = np.clip(arr, -1.0, 1.0)
-    pcm = (pcm * 32767.0).astype("<i2")
+        frames = arr[:, None]  # mono [T] -> [T, 1] regardless of layout
+    else:
+        frames = arr.T if channels_first else arr  # -> [T, C]
+    pcm = np.clip(frames, -1.0, 1.0)
+    if bits_per_sample == 16:
+        pcm = (pcm * 32767.0).astype("<i2")
+        width = 2
+    else:
+        pcm = (pcm * 2147483647.0).astype("<i4")
+        width = 4
     with wave.open(str(filepath), "wb") as w:
         w.setnchannels(pcm.shape[1])
-        w.setsampwidth(2)
+        w.setsampwidth(width)
         w.setframerate(int(sample_rate))
         w.writeframes(pcm.tobytes())
 

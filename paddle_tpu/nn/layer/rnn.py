@@ -30,7 +30,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ...framework.core import Tensor, run_op, tracing_guard, in_tracing
+from ...framework.core import Tensor, run_op, tracing_guard
 from .. import functional as F
 from .. import initializer as I
 from .layers import Layer, ParamAttr
@@ -552,11 +552,10 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
     if initial_states is None:
         initial_states = cell.get_initial_states(
             inputs, batch_dim_idx=1 if time_major else 0)
-    if in_tracing():
-        # already inside a jax trace: run the loop inline (it will be part
-        # of the enclosing jit program).
-        return _rnn_eager_loop(cell, inputs, initial_states, sequence_length,
-                               time_major, is_reverse, kwargs)
+    # Both eager and under an enclosing trace (to_static / compiled train
+    # step) the fused scan is the path: run_op executes the scan fn on the
+    # tracers, so the loop lowers to ONE lax.scan of the outer program —
+    # never an unrolled per-step trace.
     try:
         return _scan_rnn(cell, inputs, initial_states, sequence_length,
                          time_major, is_reverse, kwargs)

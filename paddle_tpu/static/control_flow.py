@@ -264,18 +264,23 @@ def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
     lowering — the payload streams back from the device per execution,
     which is exactly the reference Print op's runtime-side-effect
     semantics (a trace-time Python print would fire once)."""
-    counter = [0]
+    counter = [0]  # counts RUNTIME executions (host callback), so first_n
+    # limits prints per run, not per trace
     # under a program recorder the op ALSO executes eagerly once at build
     # time on placeholder zeros — that execution must not print
     skip_build = [_core._op_recorder is not None]
+
+    def emit(v):
+        if first_n < 0 or counter[0] < first_n:
+            counter[0] += 1
+            prefix = (message + " ") if message else ""
+            print(f"{prefix}{np.asarray(v)}", flush=True)
 
     def fn(v):
         if skip_build[0]:
             skip_build[0] = False
             return v
-        if first_n < 0 or counter[0] < first_n:
-            counter[0] += 1
-            jax.debug.print((message + " {x}") if message else "{x}", x=v)
+        jax.debug.callback(emit, v)
         return v
 
     return run_op("static_print", fn, [input])
@@ -312,13 +317,20 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """Host-side Python op inside a program (reference static/nn/common.py
     py_func). jax.pure_callback is the TPU mechanism: the callable runs on
     host at execution time with materialized arrays; `out` supplies the
-    result aval(s). backward_func, when given, becomes the custom VJP."""
+    result aval(s).
+
+    backward_func follows the reference contract: it receives
+    (inputs..., outputs..., out_grads...) MINUS any tensors listed in
+    skip_vars_in_backward_input, and returns grads for the inputs."""
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = out if isinstance(out, (list, tuple)) else [out]
     shapes = [jax.ShapeDtypeStruct(tuple(int(s) for s in o.shape),
                                    np.dtype(str(o.numpy().dtype)))
               for o in outs]
     single = not isinstance(out, (list, tuple))
+    # the build-time eager pass under a recorder must not run user code on
+    # placeholder zeros (side effects / validation errors)
+    skip_build = [_core._op_recorder is not None]
 
     def host(*vals):
         res = func(*[np.asarray(v) for v in vals])
@@ -326,36 +338,47 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         return [np.asarray(r, dtype=s.dtype).reshape(s.shape)
                 for r, s in zip(rl, shapes)]
 
-    if backward_func is None:
-        def fn(*vals):
+    def call_host(*vals):
+        if skip_build[0]:
+            skip_build[0] = False
+            res = tuple(jnp.zeros(s.shape, s.dtype) for s in shapes)
+        else:
             res = jax.pure_callback(host, shapes, *vals)
-            return res[0] if single else tuple(res)
+        return res[0] if single else tuple(res)
 
-        return run_op("py_func", fn, list(xs))
+    if backward_func is None:
+        return run_op("py_func", call_host, list(xs))
 
+    skip_ids = {id(t) for t in (skip_vars_in_backward_input or [])}
+    # positions into the (inputs..., outputs...) list handed to backward
+    keep_in = [i for i, t in enumerate(xs) if id(t) not in skip_ids]
+    keep_out = [j for j, t in enumerate(outs) if id(t) not in skip_ids]
     bwd_shapes = [jax.ShapeDtypeStruct(tuple(int(s) for s in t.shape),
                                        np.dtype(str(t.numpy().dtype)))
                   for t in xs]
 
     @jax.custom_vjp
     def core(*vals):
-        res = jax.pure_callback(host, shapes, *vals)
-        return res[0] if single else tuple(res)
+        return call_host(*vals)
 
     def core_fwd(*vals):
-        return core(*vals), vals
+        res = call_host(*vals)
+        outs_flat = (res,) if single else tuple(res)
+        return res, (vals, outs_flat)
 
-    def core_bwd(vals, ct):
-        cts = [ct] if single else list(ct)
+    def core_bwd(saved, ct):
+        vals, outs_flat = saved
+        cts = (ct,) if single else tuple(ct)
+        args = ([vals[i] for i in keep_in]
+                + [outs_flat[j] for j in keep_out] + list(cts))
 
-        def bhost(*args):
-            n = len(vals)
-            res = backward_func(*[np.asarray(a) for a in args])
+        def bhost(*a):
+            res = backward_func(*[np.asarray(v) for v in a])
             rl = res if isinstance(res, (list, tuple)) else [res]
             return [np.asarray(r, dtype=s.dtype).reshape(s.shape)
                     for r, s in zip(rl, bwd_shapes)]
 
-        gs = jax.pure_callback(bhost, bwd_shapes, *vals, *cts)
+        gs = jax.pure_callback(bhost, bwd_shapes, *args)
         return tuple(gs)
 
     core.defvjp(core_fwd, core_bwd)

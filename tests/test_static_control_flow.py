@@ -168,20 +168,39 @@ class TestStagedSideEffects:
                     fetch_list=[out])
 
     def test_py_func_forward_and_custom_backward(self):
-        def np_double(x):
-            return x * 2.0
+        """backward_func receives (x, out, dout) — the reference contract
+        (static/nn/common.py py_func)."""
+        def np_cube(x):
+            return x ** 3
 
-        def np_double_bwd(x, dy):
-            return dy * 2.0
+        def np_cube_bwd(x, y, dy):
+            assert y.shape == x.shape  # forward output IS passed
+            return dy * 3 * x ** 2
 
         xin = paddle.to_tensor(np.array([1., 2., 3.], np.float32),
                                stop_gradient=False)
         proto = paddle.to_tensor(np.zeros(3, np.float32))
-        out = static.nn.py_func(np_double, xin, proto,
-                                backward_func=np_double_bwd)
-        np.testing.assert_allclose(out.numpy(), [2., 4., 6.])
+        out = static.nn.py_func(np_cube, xin, proto,
+                                backward_func=np_cube_bwd)
+        np.testing.assert_allclose(out.numpy(), [1., 8., 27.])
         out.sum().backward()
-        np.testing.assert_allclose(xin.grad.numpy(), [2., 2., 2.])
+        np.testing.assert_allclose(xin.grad.numpy(), [3., 12., 27.])
+
+    def test_py_func_skip_vars_in_backward(self):
+        def np_cube(x):
+            return x ** 3
+
+        def np_cube_bwd_no_out(x, dy):  # out skipped
+            return dy * 3 * x ** 2
+
+        xin = paddle.to_tensor(np.array([2.], np.float32),
+                               stop_gradient=False)
+        proto = paddle.to_tensor(np.zeros(1, np.float32))
+        out = static.nn.py_func(np_cube, xin, proto,
+                                backward_func=np_cube_bwd_no_out,
+                                skip_vars_in_backward_input=[proto])
+        out.sum().backward()
+        np.testing.assert_allclose(xin.grad.numpy(), [12.])
 
     def test_py_func_in_program(self):
         prog = static.Program()
