@@ -69,12 +69,14 @@ def save(filepath, src, sample_rate, channels_first=True,
         frames = arr[:, None]  # mono [T] -> [T, 1] regardless of layout
     else:
         frames = arr.T if channels_first else arr  # -> [T, C]
-    pcm = np.clip(frames, -1.0, 1.0)
+    # scale in float64: float32 * INT32_MAX rounds to 2^31 and would wrap
+    pcm = np.clip(frames.astype(np.float64), -1.0, 1.0)
     if bits_per_sample == 16:
-        pcm = (pcm * 32767.0).astype("<i2")
+        pcm = np.clip(pcm * 32767.0, -32768, 32767).astype("<i2")
         width = 2
     else:
-        pcm = (pcm * 2147483647.0).astype("<i4")
+        pcm = np.clip(pcm * 2147483647.0,
+                      -2147483648, 2147483647).astype("<i4")
         width = 4
     with wave.open(str(filepath), "wb") as w:
         w.setnchannels(pcm.shape[1])

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from ...framework.core import Tensor, run_op
 
 __all__ = [
+    "quantize_for_inference",
     "weight_quantize",
     "weight_dequantize",
     "weight_only_linear",
@@ -153,3 +154,52 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     if bias is not None:
         ins.append(bias)
     return run_op("llm_int8_linear", fn, ins)
+
+
+def quantize_for_inference(layer, algo="weight_only_int8", group_size=-1,
+                           min_features=64):
+    """Convert a trained model's Linear sublayers to weight-only quantized
+    inference form IN PLACE (the reference flow: paddle.nn.quant
+    weight_quantize applied per layer by the serving stack).
+
+    Each eligible ``nn.Linear`` keeps int8/int4 packed weights + scales as
+    BUFFERS (the fp32 weight parameter is dropped — HBM shrinks 4-8x) and
+    its forward becomes ``weight_only_linear``. Layers smaller than
+    `min_features` on either dim stay fp (quantization noise dominates).
+    Returns the converted layer count."""
+    from .. import Linear
+    from ...distributed.fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    eligible = (Linear, ColumnParallelLinear, RowParallelLinear)
+    wdtype = "int4" if algo == "weight_only_int4" else "int8"
+    n = 0
+    for _name, sub in layer.named_sublayers(include_self=True):
+        if not isinstance(sub, eligible) or sub.weight is None:
+            continue
+        if getattr(sub, "is_mp", False):
+            # sharded layers keep their collective forward; weight-only
+            # conversion targets single-device serving
+            continue
+        in_f, out_f = int(sub.weight.shape[0]), int(sub.weight.shape[1])
+        if in_f < min_features or out_f < min_features:
+            continue
+        if algo == "weight_only_int4" and in_f % 2:
+            continue
+        if group_size > 0 and in_f % group_size:
+            continue  # same precondition weight_quantize enforces —
+            # skipping keeps the in-place conversion atomic per layer
+        q, s = weight_quantize(sub.weight, algo=algo, group_size=group_size)
+        del sub._parameters["weight"]
+        object.__setattr__(sub, "weight", None)
+        sub.register_buffer("weight_quant", q)
+        sub.register_buffer("weight_scale", s)
+
+        def _q_forward(x, _sub=sub, _dt=wdtype):
+            return weight_only_linear(
+                x, _sub.weight_quant, bias=_sub.bias,
+                weight_scale=_sub.weight_scale, weight_dtype=_dt)
+
+        sub.forward = _q_forward
+        n += 1
+    return n

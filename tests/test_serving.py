@@ -81,3 +81,30 @@ class TestContinuousBatching:
         assert b in second and a in second
         done = eng.run()
         assert {r.req_id for r in done} == {a, b}
+
+
+class TestQuantizedServing:
+    def test_weight_only_generation_and_serving(self):
+        """quantize_for_inference converts Linear (incl. degenerate
+        parallel Linear) layers to int8 weight-only buffers; generation and
+        the batching engine keep working with near-identical tokens."""
+        from paddle_tpu.nn.quant import quantize_for_inference
+
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt3_tiny())
+        prompt = np.array([5, 7, 11, 13], np.int32)
+        ref = generate(m, prompt[None], max_new_tokens=8,
+                       temperature=0.0).numpy()[0]
+        n = quantize_for_inference(m)
+        assert n > 0
+        # the fp weight params are gone from state (HBM saving is real)
+        assert not any(k.endswith("q_proj.weight")
+                       for k, _ in m.named_parameters())
+        got = generate(m, prompt[None], max_new_tokens=8,
+                       temperature=0.0).numpy()[0]
+        assert (ref == got).mean() >= 0.7
+        eng = ContinuousBatchingEngine(m, max_batch_size=2, max_seq_len=48)
+        eng.add_request(prompt, max_new_tokens=5, temperature=0.0)
+        done = eng.run()
+        np.testing.assert_array_equal(
+            done[0].output_ids, got[: len(done[0].output_ids)])
