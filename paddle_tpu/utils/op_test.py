@@ -196,11 +196,43 @@ def check_grad(spec: OpSpec):
             err_msg=f"{spec.name}: bf16 grad drifted from f32 grad")
 
 
+def check_forward_static(spec: OpSpec):
+    """The op built inside a Program and replayed by Executor.run over
+    feeds must match its eager output — the reference op tests' dual
+    dygraph+static path (test/legacy_test/op_test.py static branch)."""
+    from .. import static
+
+    vals = make_inputs(spec, np.float32)
+    eager = np.asarray(_apply(spec, vals), np.float64)
+    prog = static.Program()
+    with static.program_guard(prog):
+        phs = []
+        for i, v in enumerate(vals):
+            ph = static.data(f"optest_in{i}", list(np.asarray(v).shape),
+                             str(np.asarray(v).dtype))
+            phs.append(ph)
+        out = spec.fn(*phs, **spec.kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+    if not prog._ops or any(out is ph for ph in phs):
+        return  # identity op (e.g. atleast_1d on a >=1d input): nothing
+        # recorded, the output IS the placeholder — no static path to test
+    exe = static.Executor()
+    (got,) = exe.run(prog,
+                     feed={f"optest_in{i}": np.asarray(v)
+                           for i, v in enumerate(vals)},
+                     fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got, np.float64), eager,
+                               rtol=spec.rtol, atol=spec.atol,
+                               err_msg=f"{spec.name}: static path diverges")
+
+
 def run_all_checks(spec: OpSpec):
     check_forward(spec, np.float32)
     if spec.check_bf16:
         check_forward(spec, "bfloat16")
     if spec.check_jit:
         check_forward_jit(spec)
+        check_forward_static(spec)
     if spec.check_grad:
         check_grad(spec)

@@ -50,5 +50,10 @@ log "6. trace for the judge (BENCH_TRACE_DIR)"
 BENCH_TRACE_DIR="$OUT/trace" BENCH_CONFIG=gpt3_125m timeout 1800 python bench.py \
   | tee "$OUT/bench_125m_traced.json"
 
+log "7. round-4 additions: decode/serving throughput + RNN scan on chip"
+timeout 1200 python tools/decode_bench.py | tee "$OUT/decode_bench.json"
+timeout 1200 python -m pytest tests/test_rnn.py -q -k "scan or parity" \
+  2>&1 | tail -3 | tee "$OUT/rnn_on_tpu.txt"
+
 log "done — artifacts in $OUT/"
 ls -la "$OUT"
