@@ -46,8 +46,6 @@ from .tensor import *  # noqa: F401,F403
 from .tensor import linalg  # namespace: paddle.linalg.*
 from .tensor.logic import is_tensor
 
-__version__ = "0.1.0"
-
 
 def is_compiled_with_cuda() -> bool:
     return False
@@ -124,3 +122,48 @@ from . import onnx  # noqa: E402
 from . import signal  # noqa: E402
 from . import geometric  # noqa: E402
 from . import _C_ops  # noqa: E402  (kernel-level op surface, reference paddle._C_ops)
+from . import regularizer  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import reader  # noqa: E402
+from . import hub  # noqa: E402
+from .reader import batch  # noqa: E402
+from .hapi import callbacks  # noqa: E402
+
+
+def in_dynamic_mode():
+    """reference paddle.in_dynamic_mode — True outside static building."""
+    from . import static as _static
+
+    return not _static.in_static_mode()
+
+
+def disable_signal_handler():
+    """reference paddle.disable_signal_handler — the reference installs
+    C++ signal handlers that can conflict with other runtimes; this build
+    installs none, so there is nothing to disable (documented no-op)."""
+
+
+class version:  # noqa: N801 — reference paddle.version module shape
+    full_version = "0.4.0"
+    major, minor, patch = "0", "4", "0"
+    rc = "0"
+    cuda_version = "False"
+    cudnn_version = "False"
+    xpu_version = "False"
+    istaged = True
+    commit = "tpu-native"
+
+    @staticmethod
+    def show():
+        print(f"paddle_tpu {version.full_version} (tpu-native; XLA/PJRT)")
+
+    @staticmethod
+    def cuda():
+        return "False"
+
+    @staticmethod
+    def cudnn():
+        return "False"
+
+
+__version__ = version.full_version

@@ -1,0 +1,58 @@
+"""Model hub over LOCAL repositories (reference: python/paddle/hub.py).
+
+The reference resolves github/gitee sources by downloading; zero-egress
+here, so `source="local"` (a directory containing ``hubconf.py``) is the
+supported path and remote sources raise with a clear message."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access (zero-egress "
+            "build); clone the repo and use source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n, v in vars(mod).items()
+            if callable(v) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}/hubconf.py")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}/hubconf.py")
+    return fn(**kwargs)

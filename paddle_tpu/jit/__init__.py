@@ -298,6 +298,18 @@ class TrainStep:
         amp_level, amp_dtype = self.amp_level, self.amp_dtype
         grad_clip = opt._grad_clip
         wd = opt._decay_coeff()
+        # per-param regularizers (ParamAttr(regularizer=...)): applied to
+        # the grads inside the compiled program, and they REPLACE the
+        # optimizer-level weight_decay for their params (same semantics as
+        # the eager Optimizer.step / reference append_regularization_ops)
+        reg_specs = {}
+        for _k, _prm in state.params.items():
+            _r = getattr(_prm, "regularizer", None)
+            if _r is not None:
+                from ..regularizer import L1Decay
+
+                reg_specs[_k] = ("l1" if isinstance(_r, L1Decay) else "l2",
+                                 float(_r._coeff))
 
         # models that must see the loss inside their compiled schedule (1F1B
         # pipelining: the last stage seeds its own backward) expose
@@ -331,6 +343,13 @@ class TrainStep:
 
         def train_step(p, opt_states, b, rng, step_i, lr, batch):
             (loss, new_b), grads = jax.value_and_grad(compute_loss, has_aux=True)(p, b, rng, batch)
+            if reg_specs:
+                grads = dict(grads)
+                for k, (kind, coeff) in reg_specs.items():
+                    gk = grads[k].astype(jnp.float32)
+                    pk = p[k].astype(jnp.float32)
+                    add = coeff * (jnp.sign(pk) if kind == "l1" else pk)
+                    grads[k] = (gk + add).astype(grads[k].dtype)
             # global-norm clip (fused into the same program)
             if grad_clip is not None:
                 clip_norm = getattr(grad_clip, "clip_norm", None)
@@ -341,8 +360,9 @@ class TrainStep:
                     scale = clip_norm / jnp.maximum(gnorm, clip_norm)
                     grads = jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
             new_p, new_states = {}, {}
-            ctx = {"step": step_i, "weight_decay": wd}
             for k in p:
+                ctx = {"step": step_i,
+                       "weight_decay": 0.0 if k in reg_specs else wd}
                 st = opt_states[k]
                 master = st.get("master")
                 pv = master if master is not None else p[k]

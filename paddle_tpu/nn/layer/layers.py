@@ -147,7 +147,7 @@ class Layer:
         shape = tuple(int(s) for s in shape)
         p = Parameter(jnp.zeros(shape, jnp.dtype(d)), trainable=attr.trainable, name=attr.name)
         p.optimize_attr["learning_rate"] = attr.learning_rate
-        p.regularizer = None
+        p.regularizer = attr.regularizer
         init = default_initializer or attr.initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()

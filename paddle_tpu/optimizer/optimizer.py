@@ -89,23 +89,41 @@ class Optimizer:
         return st
 
     @no_grad()
-    def step(self):
-        params = self._parameter_list
-        if params is None:
-            raise ValueError("optimizer constructed without parameters")
+    def _collect_params_grads(self):
+        """Flatten param groups, add per-param regularizer grads (BEFORE
+        clipping — reference append_regularization_ops order), clip."""
         flat = []
-        for p in params:
+        for p in self._parameter_list or []:
             if isinstance(p, dict):
                 flat.extend(p["params"])
             else:
                 flat.append(p)
-        params_grads = [(p, p.grad) for p in flat if not p.stop_gradient and p.grad is not None]
+        params_grads = [(p, p.grad) for p in flat
+                        if not p.stop_gradient and p.grad is not None]
+        params_grads = [
+            (p, g + p.regularizer(p)) if getattr(p, "regularizer", None)
+            else (p, g)
+            for p, g in params_grads]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
+        return params_grads
+
+    def _param_wd(self, p, base_wd):
+        """A per-param regularizer REPLACES the optimizer-level coeff for
+        that param (reference semantics) — never both."""
+        return 0.0 if getattr(p, "regularizer", None) else base_wd
+
+    @no_grad()
+    def step(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        params_grads = self._collect_params_grads()
         self._step_count += 1
-        ctx = {"step": self._step_count, "weight_decay": self._decay_coeff()}
+        base_wd = self._decay_coeff()
         lr = self.get_lr()
         for p, g in params_grads:
+            ctx = {"step": self._step_count,
+                   "weight_decay": self._param_wd(p, base_wd)}
             st = self._get_state(p)
             pv = st.get("master", p._value)
             gv = g._value.astype(pv.dtype)
@@ -256,19 +274,12 @@ class AdamW(Adam):
         if self._apply_decay_param_fun is None:
             return super().step()
         base_wd = self._decay_coeff()
-        flat = []
-        for p in self._parameter_list or []:
-            if isinstance(p, dict):
-                flat.extend(p["params"])
-            else:
-                flat.append(p)
-        params_grads = [(p, p.grad) for p in flat if not p.stop_gradient and p.grad is not None]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
+        params_grads = self._collect_params_grads()
         self._step_count += 1
         lr = self.get_lr()
         for p, g in params_grads:
-            wd = base_wd if self._apply_decay_param_fun(p.name or "") else 0.0
+            wd = self._param_wd(p, base_wd) \
+                if self._apply_decay_param_fun(p.name or "") else 0.0
             ctx = {"step": self._step_count, "weight_decay": wd}
             st = self._get_state(p)
             pv = st.get("master", p._value)

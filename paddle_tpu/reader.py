@@ -1,0 +1,121 @@
+"""Legacy reader decorators (reference: python/paddle/reader/decorator.py
+and paddle.batch). Generator-based pipelines predating DataLoader; kept
+because tutorial-era training scripts compose them."""
+
+from __future__ import annotations
+
+import random as _random
+
+__all__ = ["batch", "shuffle", "buffered", "chain", "compose", "map_readers",
+           "cache", "firstn"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference python/paddle/batch.py — group samples into lists."""
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return gen
+
+
+def shuffle(reader, buf_size):
+    """reference reader/decorator.py shuffle."""
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+
+    return gen
+
+
+def buffered(reader, size):
+    """reference reader/decorator.py buffered — here an eager list buffer
+    (host threads add nothing: the DataLoader owns async prefetch)."""
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= size:
+                yield from buf
+                buf = []
+        yield from buf
+
+    return gen
+
+
+def chain(*readers):
+    def gen():
+        for r in readers:
+            yield from r()
+
+    return gen
+
+
+def compose(*readers, check_alignment=True):
+    def gen():
+        iters = [r() for r in readers]
+        while True:
+            outs = []
+            stop = 0
+            for it in iters:
+                try:
+                    outs.append(next(it))
+                except StopIteration:
+                    stop += 1
+            if stop:
+                if check_alignment and 0 < stop < len(iters):
+                    raise ValueError("readers have different lengths")
+                return
+            # flatten: tuples from each reader concatenate (reference
+            # compose semantics)
+            yield tuple(sum(((o if isinstance(o, tuple) else (o,))
+                             for o in outs), ()))
+
+    return gen
+
+
+def map_readers(func, *readers):
+    def gen():
+        for args in zip(*[r() for r in readers]):
+            yield func(*args)
+
+    return gen
+
+
+def cache(reader):
+    data = []
+    filled = [False]
+
+    def gen():
+        if not filled[0]:
+            for item in reader():
+                data.append(item)
+                yield item
+            filled[0] = True
+        else:
+            yield from data
+
+    return gen
+
+
+def firstn(reader, n):
+    def gen():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+
+    return gen

@@ -1,0 +1,44 @@
+"""Per-parameter regularizers (reference: python/paddle/regularizer.py
+L1Decay/L2Decay; applied by append_regularization_ops before clipping).
+
+A ParamAttr(regularizer=...) attaches one of these to a Parameter; the
+optimizer adds its gradient contribution before grad clipping, matching
+the reference order. A per-param regularizer takes precedence over the
+optimizer-level weight_decay for that parameter."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import run_op
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    """grad += coeff * sign(param) (reference regularizer.py L1Decay)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, param):
+        c = self._coeff
+        return run_op("l1_decay_grad",
+                      lambda p: c * jnp.sign(p), [param])
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._coeff})"
+
+
+class L2Decay:
+    """grad += coeff * param (reference regularizer.py L2Decay)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, param):
+        c = self._coeff
+        return run_op("l2_decay_grad", lambda p: c * p, [param])
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._coeff})"

@@ -1,0 +1,18 @@
+"""reference: python/paddle/sysconfig.py — include/lib dirs for building
+extensions against the framework (here: the native runtime library)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(os.path.dirname(_ROOT), "native")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(_ROOT), "native")

@@ -26,6 +26,9 @@ __all__ = [
     "edit_distance",
     "clip_by_norm",
     "standard_gamma",
+    "histogramdd",
+    "cauchy_",
+    "geometric_",
 ]
 
 
@@ -179,5 +182,58 @@ def standard_gamma(x, name=None):
 
 for _name in ("fill_diagonal_", "fill_diagonal_tensor",
               "fill_diagonal_tensor_", "reduce_as", "clip_by_norm"):
+    if not hasattr(Tensor, _name):
+        register_tensor_method(_name, globals()[_name])
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """reference tensor/linalg.py histogramdd — host-side (variable bin
+    edges are data-dependent metadata). Returns (hist, edges_list)."""
+    sample = np.asarray(x._value if isinstance(x, Tensor) else x)
+    w = np.asarray(weights._value if isinstance(weights, Tensor)
+                   else weights) if weights is not None else None
+    if isinstance(bins, Tensor):
+        bins = np.asarray(bins._value)
+    if isinstance(bins, (list, tuple)):
+        bins = [np.asarray(b._value) if isinstance(b, Tensor) else b
+                for b in bins]
+    if ranges is not None:
+        flat = [float(v) for v in np.asarray(
+            ranges._value if isinstance(ranges, Tensor) else ranges
+        ).reshape(-1)]
+        ranges = [(flat[2 * i], flat[2 * i + 1])
+                  for i in range(len(flat) // 2)]  # paddle passes 2*D flat
+    hist, edges = np.histogramdd(sample, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return (to_tensor(hist.astype(np.float32)),
+            [to_tensor(e.astype(np.float32)) for e in edges])
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    """In-place Cauchy fill (reference tensor/random.py cauchy_)."""
+    from ..framework import random as rnd
+
+    def fn(a, key):
+        return loc + scale * jax.random.cauchy(key, a.shape, a.dtype)
+
+    out = run_op("cauchy", fn, [x, rnd.rng_tensor()])
+    return x._inplace_update(out) if isinstance(x, Tensor) else out
+
+
+def geometric_(x, probs, name=None):
+    """In-place Geometric(probs) fill (reference tensor/random.py
+    geometric_)."""
+    from ..framework import random as rnd
+
+    def fn(a, key):
+        u = jax.random.uniform(key, a.shape, jnp.float32, 1e-7, 1.0)
+        return (jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(a.dtype)
+
+    out = run_op("geometric", fn, [x, rnd.rng_tensor()])
+    return x._inplace_update(out) if isinstance(x, Tensor) else out
+
+
+for _name in ("cauchy_", "geometric_"):
     if not hasattr(Tensor, _name):
         register_tensor_method(_name, globals()[_name])

@@ -611,3 +611,57 @@ class TestTextDatasets:
             TD.Imdb(download=True)
         with pytest.raises(RuntimeError):
             TD.UCIHousing()
+
+
+class TestNamespaceBatch:
+    def test_regularizer_applies_before_clip(self):
+        from paddle_tpu import regularizer
+
+        lin = paddle.nn.Linear(
+            4, 4, weight_attr=paddle.ParamAttr(
+                regularizer=regularizer.L2Decay(0.5)))
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        loss = lin(paddle.to_tensor(np.zeros((2, 4), np.float32))).sum()
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 * (1 - 0.1 * 0.5), rtol=1e-5)
+        g = regularizer.L1Decay(0.3)(
+            paddle.to_tensor(np.array([2.0, -3.0], np.float32)))
+        np.testing.assert_allclose(g.numpy(), [0.3, -0.3])
+
+    def test_reader_decorators(self):
+        r = lambda: iter(range(10))  # noqa: E731
+        assert [b for b in paddle.batch(r, 3)()][0] == [0, 1, 2]
+        assert len([b for b in paddle.batch(r, 3, drop_last=True)()]) == 3
+        assert sorted(x for x in paddle.reader.shuffle(r, 5)()) == \
+            list(range(10))
+        comp = [x for x in paddle.reader.compose(
+            lambda: iter([1, 2]), lambda: iter([(3, 4), (5, 6)]))()]
+        assert comp == [(1, 3, 4), (2, 5, 6)]
+
+    def test_version_and_misc(self):
+        assert paddle.__version__ == paddle.version.full_version
+        assert paddle.in_dynamic_mode() is True
+        paddle.disable_signal_handler()
+        assert paddle.sysconfig.get_include().endswith("native")
+
+    def test_histogramdd_cauchy_geometric(self):
+        h, edges = paddle.histogramdd(
+            paddle.to_tensor(rng.standard_normal((100, 2))
+                             .astype("float32")), bins=4)
+        assert h.shape == [4, 4] and len(edges) == 2
+        assert float(h.numpy().sum()) == 100
+        t = paddle.to_tensor(np.zeros(1000, np.float32))
+        t.geometric_(0.5)
+        assert t.numpy().min() >= 1 and 1.5 < t.numpy().mean() < 2.5
+
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(scale=1):\n    'doc'\n    return scale * 2\n")
+        assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+        assert paddle.hub.load(str(tmp_path), "tiny", scale=3) == 6
+        with pytest.raises(RuntimeError):
+            paddle.hub.load("org/repo", "m", source="github")
