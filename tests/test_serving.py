@@ -108,3 +108,21 @@ class TestQuantizedServing:
         done = eng.run()
         np.testing.assert_array_equal(
             done[0].output_ids, got[: len(done[0].output_ids)])
+
+
+class TestLlamaServing:
+    def test_llama_gqa_through_engine(self):
+        """GQA models (kv_heads < num_heads) run through the slotted cache
+        and match plain generate()."""
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny())
+        prompt = np.array([3, 5, 7], np.int32)
+        ref = generate(m, prompt[None], max_new_tokens=6,
+                       temperature=0.0).numpy()[0]
+        eng = ContinuousBatchingEngine(m, max_batch_size=2, max_seq_len=48)
+        eng.add_request(prompt, max_new_tokens=6, temperature=0.0)
+        done = eng.run()
+        np.testing.assert_array_equal(
+            done[0].output_ids, ref[: len(done[0].output_ids)])
