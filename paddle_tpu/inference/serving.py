@@ -177,19 +177,29 @@ class ContinuousBatchingEngine:
                 pos = offs[:, None]
                 logits, new_c = self._functional_forward(
                     p, b, tok[:, None], pos, caches, offs)
-                return logits[:, -1], new_c
+                last = logits[:, -1]
+                # greedy tokens picked ON DEVICE: the [B, vocab] logits
+                # only cross to host when a sampled-temperature request
+                # needs them (jax arrays materialize lazily)
+                return jnp.argmax(last, axis=-1).astype(jnp.int32), \
+                    last, new_c
 
             self._decode_jit = jax.jit(decode, donate_argnums=(4,))
 
         offs = jnp.asarray(self.lengths)  # per-slot write offset
-        logits, self.caches = self._decode_jit(
+        greedy_tok, logits, self.caches = self._decode_jit(
             self.params, self.buffers, jnp.asarray(self.last_tok), offs,
             self.caches)
-        logits = np.asarray(logits)
+        need_logits = any(self.active[i].temperature != 0.0 for i in live)
+        greedy_np = np.asarray(greedy_tok)
+        logits_np = np.asarray(logits) if need_logits else None
         out = {}
         for i in live:
             req = self.active[i]
-            tok = self._pick_token(logits[i], req)
+            if req.temperature == 0.0:
+                tok = int(greedy_np[i])
+            else:
+                tok = self._pick_token(logits_np[i], req)
             self.lengths[i] += 1
             self.last_tok[i] = tok
             out[req.req_id] = tok
