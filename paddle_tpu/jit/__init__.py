@@ -533,7 +533,18 @@ def save(layer, path, input_spec=None, **configs):
         sds = [s._sds(scope) if isinstance(s, InputSpec) else
                jax.ShapeDtypeStruct(tuple(s.shape), jnp.dtype(s.dtype))
                for s in input_spec]
-        exported = jexport.export(jax.jit(fwd))(*sds)
+        # the serving artifact is a SINGLE-device program: a lingering
+        # global training mesh (DistributedTrainStep sets one) must not
+        # leak into the export, or the saved model demands that device
+        # count at load time (jax.export records nr_devices)
+        from ..distributed import env as _dist_env
+
+        prev_mesh = _dist_env.get_global_mesh()
+        _dist_env.set_global_mesh(None)
+        try:
+            exported = jexport.export(jax.jit(fwd))(*sds)
+        finally:
+            _dist_env.set_global_mesh(prev_mesh)
         with open(path + ".pdmodel", "wb") as f:
             f.write(exported.serialize())
 

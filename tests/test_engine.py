@@ -3,12 +3,21 @@ python/paddle/distributed/auto_parallel/static/engine.py fit/evaluate/
 predict/save/load over a parallelized program)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as opt
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_mesh():
+    """Engine.prepare sets the sticky global mesh; tests must not leak it
+    into later test files (jit.save would export for 8 devices)."""
+    yield
+    dist.env.set_global_mesh(None)
 
 
 def _setup():
@@ -41,7 +50,6 @@ def test_engine_fit_evaluate_predict():
     assert np.isfinite(ev["loss"])
     preds = engine.predict(test_data=(x, y), batch_size=8)
     assert preds and preds[0].shape == (8, 4)
-    dist.env.set_global_mesh(None)
 
 
 def test_engine_save_load(tmp_path):
@@ -58,11 +66,9 @@ def test_engine_save_load(tmp_path):
     xa = paddle.to_tensor(x[:4])
     np.testing.assert_allclose(model2(xa).numpy(), model(xa).numpy(),
                                atol=1e-6)
-    dist.env.set_global_mesh(None)
 
 
 def test_engine_rejects_oversized_mesh():
-    import pytest
 
     model, crit, optimizer = _setup()
     strategy = Strategy()
@@ -71,7 +77,6 @@ def test_engine_rejects_oversized_mesh():
                     strategy=strategy)
     with pytest.raises(ValueError, match="exceeds"):
         engine.prepare()
-    dist.env.set_global_mesh(None)
 
 
 def test_engine_predict_without_optimizer_and_partial_batch():
@@ -84,7 +89,6 @@ def test_engine_predict_without_optimizer_and_partial_batch():
     assert sum(p.shape[0] for p in preds) == 10
     ev = engine.evaluate(valid_data=(x, y), batch_size=8)
     assert np.isfinite(ev["loss"])
-    dist.env.set_global_mesh(None)
 
 
 def test_engine_save_carries_optimizer_state(tmp_path):
@@ -104,7 +108,6 @@ def test_engine_save_carries_optimizer_state(tmp_path):
               for st in param_states for t in st.values()]
     assert any(np.abs(l).max() > 0 for l in leaves), \
         "optimizer checkpoint holds only init state"
-    dist.env.set_global_mesh(None)
 
 
 def test_engine_cost_model():
@@ -137,8 +140,6 @@ def test_engine_plan_search():
     assert pp * shard * mp > 1
     assert dp * pp * shard * mp == 8
     # impossible cap: explicit failure, not a silent bad plan
-    import pytest
-
     with pytest.raises(RuntimeError):
         e.plan(2, model_cfg={"hidden_size": 8192, "num_layers": 96,
                              "vocab_size": 50304, "seq_length": 4096,
