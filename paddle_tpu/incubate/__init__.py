@@ -15,3 +15,43 @@ from .operators import (  # noqa: E402,F401
     softmax_mask_fuse_upper_triangle,
 )
 __all__ += ["operators", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+
+class _AutotuneNS:
+    """reference paddle.incubate.autotune.set_config — maps onto the Pallas
+    block-size autotuner (ops/pallas/autotune.py, PADDLE_TPU_AUTOTUNE)."""
+
+    @staticmethod
+    def set_config(config=None):
+        import os
+
+        enable = True
+        if isinstance(config, dict):
+            kernel = config.get("kernel", {})
+            enable = bool(kernel.get("enable", True))
+        os.environ["PADDLE_TPU_AUTOTUNE"] = "1" if enable else "0"
+
+
+autotune = _AutotuneNS()
+__all__.append("autotune")
+
+
+class _JitNS:
+    """reference paddle.incubate.jit.inference — compile a callable/Layer
+    for inference (maps to to_static + eval)."""
+
+    @staticmethod
+    def inference(function=None, **kw):
+        from .. import jit as _jit
+        import paddle_tpu.nn as _nn
+
+        def wrap(f):
+            if isinstance(f, _nn.Layer):
+                f.eval()
+            return _jit.to_static(f)
+
+        return wrap if function is None else wrap(function)
+
+
+jit = _JitNS()
+__all__.append("jit")
