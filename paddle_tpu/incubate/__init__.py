@@ -23,8 +23,17 @@ class _AutotuneNS:
 
     @staticmethod
     def set_config(config=None):
+        import json
         import os
 
+        if isinstance(config, str):
+            # reference accepts a JSON config file path
+            with open(config) as f:
+                config = json.load(f)
+        if config is not None and not isinstance(config, dict):
+            raise TypeError(
+                f"set_config expects None, dict, or a JSON file path; got "
+                f"{type(config).__name__}")
         enable = True
         if isinstance(config, dict):
             kernel = config.get("kernel", {})
@@ -44,6 +53,14 @@ class _JitNS:
     def inference(function=None, **kw):
         from .. import jit as _jit
         import paddle_tpu.nn as _nn
+
+        if kw:
+            import warnings
+
+            warnings.warn(
+                f"incubate.jit.inference: ignoring unsupported options "
+                f"{sorted(kw)} (XLA owns caching and precision here)",
+                stacklevel=2)
 
         def wrap(f):
             if isinstance(f, _nn.Layer):

@@ -21,8 +21,8 @@ from paddle_tpu.ops.pallas.decode_attention import (
 
 
 @pytest.fixture(autouse=True)
-def _interpret_mode(monkeypatch):
-    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+def _interpret_mode(pallas_interpret_unless_hw):
+    pass
 
 
 # --------------------------------------------------------------------------- #

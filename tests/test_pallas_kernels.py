@@ -14,8 +14,8 @@ from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
 
 
 @pytest.fixture(autouse=True)
-def _interpret_mode(monkeypatch):
-    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+def _interpret_mode(pallas_interpret_unless_hw):
+    pass
 
 
 def _ref(q, k, v, causal, scale=None):

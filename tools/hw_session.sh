@@ -25,7 +25,7 @@ log "1. baseline bench (gpt3_125m) BEFORE any validation churn"
 BENCH_CONFIG=gpt3_125m timeout 1800 python bench.py | tee "$OUT/bench_125m.json"
 
 log "2. Pallas kernel validation on real Mosaic (512x512 blocks)"
-timeout 2400 python -m pytest tests/test_pallas_kernels.py tests/test_masked_flash.py -x -q \
+PADDLE_TPU_HW=1 timeout 2400 python -m pytest tests/test_pallas_kernels.py tests/test_masked_flash.py -x -q \
   2>&1 | tee "$OUT/kernel_validation.txt" | tail -5
 echo "kernel validation rc=${PIPESTATUS[0]}" | tee -a "$OUT/kernel_validation.txt"
 
@@ -52,7 +52,7 @@ BENCH_TRACE_DIR="$OUT/trace" BENCH_CONFIG=gpt3_125m timeout 1800 python bench.py
 
 log "7. round-4 additions: decode/serving throughput + RNN scan on chip"
 timeout 1200 python tools/decode_bench.py | tee "$OUT/decode_bench.json"
-timeout 1200 python -m pytest tests/test_rnn.py -q -k "scan or parity" \
+PADDLE_TPU_HW=1 timeout 1200 python -m pytest tests/test_rnn.py -q -k "scan or parity" \
   2>&1 | tail -3 | tee "$OUT/rnn_on_tpu.txt"
 
 log "done — artifacts in $OUT/"
