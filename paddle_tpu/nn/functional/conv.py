@@ -66,16 +66,24 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
         ins.append(_t(bias))
 
     def fn(a, w, *rest):
-        acc = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+        # No preferred_element_type: the MXU already accumulates bf16 convs in
+        # f32 natively, and requesting an f32 output breaks JAX's conv
+        # transpose rule under AMP O2 (bf16 lhs vs f32 cotangent ->
+        # "requires arguments to have the same dtypes"). Reference AMP white
+        # list keeps conv in low precision (python/paddle/amp/amp_lists.py).
+        # float16 has no native MXU path and only ~3 exponent headroom bits,
+        # so its convs run through an f32 upcast (differentiable, keeps f32
+        # accumulation) rather than preferred_element_type.
+        a_c, w_c = (a, w) if a.dtype != jnp.float16 else (
+            a.astype(jnp.float32), w.astype(jnp.float32))
         out = jax.lax.conv_general_dilated(
-            a,
-            w,
+            a_c,
+            w_c,
             window_strides=strides,
             padding=pad,
             rhs_dilation=dil,
             dimension_numbers=dn,
             feature_group_count=int(groups),
-            preferred_element_type=acc,
         ).astype(a.dtype)
         if rest:
             b = rest[0]
@@ -114,6 +122,9 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
         # weight layout is [in_c, out_c/groups, *k] (paddle transpose-conv
         # convention); use gradient-based transpose conv:
         # conv_transpose = lhs-dilated conv with flipped kernel
+        out_dtype = a.dtype
+        if a.dtype == jnp.float16:  # f32 accumulation (see _conv above)
+            a, w = a.astype(jnp.float32), w.astype(jnp.float32)
         if channels_last:
             a_ncx = jnp.moveaxis(a, -1, 1)
         else:
@@ -127,9 +138,6 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
                 pads = [(0, 0)] * n
         else:
             pads = pad
-        # flip spatial dims, swap io: [in, out/g, *k] -> [out, in/g... ]
-        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
-        wf = jnp.swapaxes(wf, 0, 1)  # [out_c/g, in_c, *k]
         if groups > 1:
             # regroup: full weight [in_c, out_c/g, *k] with groups along in_c
             wg = w.reshape((groups, in_c // groups) + w.shape[1:])
@@ -140,13 +148,16 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
                 outs.append(_transpose_one(a_ncx[:, g * (in_c // groups):(g + 1) * (in_c // groups)], wgf, strides, pads, dil, opad, n))
             out = jnp.concatenate(outs, axis=1)
         else:
+            # flip spatial dims, swap io: [in, out, *k] -> [out, in, *k]
+            wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+            wf = jnp.swapaxes(wf, 0, 1)
             out = _transpose_one(a_ncx, wf, strides, pads, dil, opad, n)
         if rest:
             b = rest[0]
             out = out + b.reshape((1, b.size) + (1,) * n)
         if channels_last:
             out = jnp.moveaxis(out, 1, -1)
-        return out.astype(a.dtype)
+        return out.astype(out_dtype)
 
     return run_op(f"conv{n}d_transpose", fn, ins)
 
