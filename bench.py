@@ -132,18 +132,63 @@ def _decoder_flops(cfg, batch, seq):
             + 12.0 * cfg.num_layers * cfg.hidden_size * seq * tokens)
 
 
-def _decoder_step(cfg, batch, seq, on_tpu, **step_kw):
+def _free_rung(*objs):
+    """Release a failed/finished rung's device buffers before the next one
+    allocates (round-4 lesson: the 1.3B OOM left 15GB of params+states live
+    while the 350M fallback tried to allocate)."""
+    import gc
+
+    for o in objs:
+        try:
+            if hasattr(o, "params"):  # TrainStep: drop device state dicts
+                o.params = {}
+                o.opt_states = {}
+                o.buffers = {}
+                # the same buffers stay live through model Parameters
+                # (_ModuleState) — null those refs too or nothing is freed
+                o.model = None
+                o._state = None
+                o._compiled = None
+                # optimizer._parameter_list also pins the Parameters
+                if getattr(o, "optimizer", None) is not None:
+                    o.optimizer._parameter_list = None
+                    o.optimizer = None
+        except Exception:
+            pass
+    del objs
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
+def _decoder_step(cfg, batch, seq, on_tpu, low_mem=False, **step_kw):
     """Shared scaffold: seeded model + criterion + AdamW + single-device mesh
-    + DistributedTrainStep + random token batch. Returns (step, ids, labels)."""
+    + DistributedTrainStep + random token batch. Returns (step, ids, labels).
+
+    low_mem (the 1.3B-on-one-16GB-chip recipe): bf16 params via amp.decorate
+    + bf16 AdamW moments (f32 update compute) + per-layer recompute. Steady
+    HBM for 1.3B drops 15.6GB -> ~7.8GB; the f32-master recipe needs >1 chip
+    (that path is exercised by the sharded dryrun/tests instead)."""
     import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
     import paddle_tpu.distributed as dist
     import paddle_tpu.optimizer as opt
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
 
     paddle.seed(0)
+    if low_mem:
+        cfg.use_recompute = True
     model = GPTForCausalLM(cfg)
     crit = GPTPretrainingCriterion(cfg)
-    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    if low_mem:
+        amp.decorate(model, level="O2", dtype="bfloat16")
+        optimizer = opt.AdamW(learning_rate=1e-4, moment_dtype="bfloat16",
+                              parameters=model.parameters())
+    else:
+        optimizer = opt.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
     mesh = dist.build_mesh(devices=jax.devices()[:1])
     # bf16 compute with f32 master weights — the production TPU recipe
     step = dist.DistributedTrainStep(
@@ -175,22 +220,32 @@ def run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir=None):
         ["gpt3_1p3b", "gpt3_350m", "gpt3_125m"] if on_tpu else ["cpu_smoke"])
 
     fallback_note = None
+    step = ids = labels = None
     for idx, name in enumerate(ladder):
-        cfg, batch, seq, steps = build(name)
-        step, ids, labels = _decoder_step(cfg, batch, seq, on_tpu)
+        # the WHOLE rung — model/optimizer/state allocation included — is
+        # inside the try: round 4's 1.3B run OOMed at construction, outside
+        # the old warmup-only try, so the fallback never ran
         try:
+            cfg, batch, seq, steps = build(name)
+            low_mem = name == "gpt3_1p3b"
+            step, ids, labels = _decoder_step(cfg, batch, seq, on_tpu,
+                                              low_mem=low_mem)
             _ = float(step(ids, labels))  # compile + warmup
             break
         except Exception as e:
             if idx + 1 >= len(ladder):
                 raise
             fallback_note = f"{name} failed ({type(e).__name__}), fell back"
+            _free_rung(step, ids, labels)
+            step = ids = labels = None
             dist.env.set_global_mesh(None)
             continue
 
     dt = _timed_steps(lambda: step(ids, labels), steps, trace_dir)
     flops = _decoder_flops(cfg, batch, seq)
     extra = {}
+    if name == "gpt3_1p3b":
+        extra["recipe"] = "bf16_params+bf16_moments+recompute"
     if init_error:
         extra["error"] = f"degraded to cpu: {init_error}"[:400]
     if fallback_note:
@@ -335,6 +390,7 @@ def main():
                                   "error": f"{type(e).__name__}: {e}"[:300]}),
                       flush=True)
             dist.env.set_global_mesh(None)
+            _free_rung()  # gc + clear_caches between rungs
         # headline GPT line LAST (drivers read the final line); a degraded
         # (wedged-tunnel) run must never build a TPU-sized config on host
         run_gpt_rung("cpu_smoke" if init_error else cfg_name, on_tpu,

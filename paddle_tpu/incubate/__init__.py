@@ -25,20 +25,34 @@ class _AutotuneNS:
     def set_config(config=None):
         import json
         import os
+        import warnings
 
         if isinstance(config, str):
-            # reference accepts a JSON config file path
-            with open(config) as f:
-                config = json.load(f)
+            # reference warns and falls back to defaults on an unreadable or
+            # invalid JSON path (python/paddle/incubate/autotune.py)
+            try:
+                with open(config) as f:
+                    config = json.load(f)
+            except Exception as e:
+                warnings.warn(
+                    f"set_config: cannot load config file {config!r} "
+                    f"({type(e).__name__}: {e}); using default config.")
+                config = None
         if config is not None and not isinstance(config, dict):
-            raise TypeError(
+            warnings.warn(
                 f"set_config expects None, dict, or a JSON file path; got "
-                f"{type(config).__name__}")
-        enable = True
-        if isinstance(config, dict):
-            kernel = config.get("kernel", {})
-            enable = bool(kernel.get("enable", True))
-        os.environ["PADDLE_TPU_AUTOTUNE"] = "1" if enable else "0"
+                f"{type(config).__name__}; using default config.")
+            config = None
+        if config is None:
+            # default = enable all tuning, reference behavior
+            os.environ["PADDLE_TPU_AUTOTUNE"] = "1"
+            return
+        # reference only touches kernel autotune when the dict actually
+        # carries a kernel section
+        kernel = config.get("kernel")
+        if isinstance(kernel, dict) and "enable" in kernel:
+            os.environ["PADDLE_TPU_AUTOTUNE"] = \
+                "1" if bool(kernel["enable"]) else "0"
 
 
 autotune = _AutotuneNS()

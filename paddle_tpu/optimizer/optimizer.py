@@ -229,31 +229,48 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, use_multi_tensor=False, name=None):
+                 multi_precision=False, use_multi_tensor=False, moment_dtype=None,
+                 name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._decoupled = False  # Adam: L2 into grad; AdamW: decoupled
+        # TPU extension: store m/v in a low-precision dtype (e.g. "bfloat16")
+        # so a 1.3B AdamW fits one 16GB chip — halves optimizer-state HBM.
+        # The update still computes in f32 (reference fused_adam MPType,
+        # paddle/phi/kernels/fusion/gpu/fused_adam_kernel.cu accumulates in
+        # MPDType regardless of storage dtype).
+        self._moment_dtype = (getattr(jnp, moment_dtype)
+                              if isinstance(moment_dtype, str) else moment_dtype)
 
     def init_state(self, p):
-        f32 = jnp.float32 if p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype
-        return {"m": jnp.zeros_like(p, dtype=f32), "v": jnp.zeros_like(p, dtype=f32)}
+        if self._moment_dtype is not None:
+            mdt = self._moment_dtype
+        else:
+            mdt = jnp.float32 if p.dtype in (jnp.bfloat16, jnp.float16) else p.dtype
+        return {"m": jnp.zeros_like(p, dtype=mdt), "v": jnp.zeros_like(p, dtype=mdt)}
 
     def update(self, p, g, state, lr, ctx):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         t = ctx["step"]
         wd = ctx["weight_decay"]
+        # compute in f32, store back in each tensor's own dtype — exact
+        # no-op for the default all-f32 path
+        m_dt, v_dt, p_dt = state["m"].dtype, state["v"].dtype, p.dtype
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
         if wd and not self._decoupled:
-            g = g + wd * p
-        m = b1 * state["m"] + (1 - b1) * g
-        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+            g32 = g32 + wd * p32
+        m = b1 * state["m"].astype(jnp.float32) + (1 - b1) * g32
+        v = b2 * state["v"].astype(jnp.float32) + (1 - b2) * jnp.square(g32)
         mhat = m / (1 - b1**t)
         vhat = v / (1 - b2**t)
         upd = mhat / (jnp.sqrt(vhat) + eps)
         if wd and self._decoupled:
-            upd = upd + wd * p
-        return p - lr * upd, {"m": m, "v": v}
+            upd = upd + wd * p32
+        return ((p32 - lr * upd).astype(p_dt),
+                {"m": m.astype(m_dt), "v": v.astype(v_dt)})
 
 
 class AdamW(Adam):
@@ -262,9 +279,10 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, name=None):
+                 multi_precision=False, moment_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, lazy_mode, multi_precision, name=name)
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         moment_dtype=moment_dtype, name=name)
         self._decoupled = True
         self._apply_decay_param_fun = apply_decay_param_fun
 

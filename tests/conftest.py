@@ -51,3 +51,27 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # hardware-validation session.
 if not _ON_HW:
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    """PADDLE_TPU_HW=1 runs on the real chip, where the virtual 8-device CPU
+    mesh is NOT configured — multi-device tests would all fail on a 1-chip
+    host. Only the hardware-validation subsets (tools/hw_session.sh: Pallas
+    kernels, masked flash, RNN scan) are meant for that flag; skip the rest
+    instead of failing them."""
+    if not _ON_HW:
+        return
+    n = len(jax.devices())
+    if n >= 8:
+        return
+    hw_safe = {
+        "test_pallas_kernels.py", "test_masked_flash.py", "test_rnn.py",
+        "test_autotune.py", "test_fused_attention.py", "test_amp_conv.py",
+    }
+    skip = pytest.mark.skip(
+        reason=f"PADDLE_TPU_HW=1 with {n} device(s): needs the 8-device "
+               "virtual CPU mesh (run without the flag, or use the "
+               "tools/hw_session.sh subsets)")
+    for item in items:
+        if item.fspath.basename not in hw_safe:
+            item.add_marker(skip)

@@ -2,6 +2,7 @@
 paddle/phi/kernels/autotune/cache.h AutoTuneCache + auto_tune_base.h
 candidate measurement)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -121,3 +122,46 @@ def test_flash_entry_default_under_interpret(monkeypatch):
     q = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
     out = flash_attention_fwd(q, q, q, causal=True)
     assert out.shape == q.shape and bool(jnp.isfinite(out).all())
+
+
+class TestSetConfig:
+    """incubate.autotune.set_config error semantics (reference:
+    python/paddle/incubate/autotune.py — warn + fall back, never raise)."""
+
+    def test_bad_path_warns_and_defaults(self, monkeypatch):
+        import warnings
+        import paddle_tpu.incubate as incubate
+
+        monkeypatch.delenv("PADDLE_TPU_AUTOTUNE", raising=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            incubate.autotune.set_config("/nonexistent/autotune.json")
+        assert any("cannot load" in str(x.message) for x in w)
+        assert os.environ["PADDLE_TPU_AUTOTUNE"] == "1"
+
+    def test_non_dict_json_warns_and_defaults(self, tmp_path, monkeypatch):
+        import warnings
+        import paddle_tpu.incubate as incubate
+
+        p = tmp_path / "cfg.json"
+        p.write_text("[1, 2, 3]")
+        monkeypatch.delenv("PADDLE_TPU_AUTOTUNE", raising=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            incubate.autotune.set_config(str(p))
+        assert any("expects" in str(x.message) for x in w)
+        assert os.environ["PADDLE_TPU_AUTOTUNE"] == "1"
+
+    def test_dict_without_kernel_leaves_autotune_untouched(self, monkeypatch):
+        import paddle_tpu.incubate as incubate
+
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "0")
+        incubate.autotune.set_config({"layout": {"enable": True}})
+        assert os.environ["PADDLE_TPU_AUTOTUNE"] == "0"
+
+    def test_kernel_enable_false(self, monkeypatch):
+        import paddle_tpu.incubate as incubate
+
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+        incubate.autotune.set_config({"kernel": {"enable": False}})
+        assert os.environ["PADDLE_TPU_AUTOTUNE"] == "0"

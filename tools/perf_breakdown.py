@@ -20,25 +20,46 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def timeit(fn, *args, reps=5, warmup=2):
+def _host_sync(out):
+    """Force a REAL device->host fetch. Round-4 lesson: through the
+    experimental axon tunnel jax.block_until_ready returned before device
+    execution finished, so probes measured dispatch latency (681% of peak,
+    8192^3 matmuls in 0.03ms). Fetching a literal cannot lie: TPU execution
+    is in-order per device, so materializing the last output on the host
+    proves every prior dispatch completed."""
+    leaf = jax.tree.leaves(out)[0]
+    # slice on DEVICE first so only one element crosses the bus — fetching
+    # the whole array (e.g. a 128MB matmul output) would inflate the timed
+    # region with transfer time
+    one = leaf.ravel()[0:1] if getattr(leaf, "ndim", 0) else leaf
+    return np.asarray(jax.device_get(one))
+
+
+def timeit(fn, *args, reps=20, warmup=3):
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _host_sync(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _host_sync(out)  # in-order device stream => all reps done
     return (time.perf_counter() - t0) / reps
 
 
 def report(probe, dt, flops, peak):
     tf = flops / dt / 1e12
-    print(json.dumps({
+    eff = flops / dt / peak
+    line = {
         "probe": probe,
-        "ms": round(dt * 1e3, 2),
+        "ms": round(dt * 1e3, 3),
         "tflops": round(tf, 1),
-        "eff_vs_peak": round(flops / dt / peak, 3),
-    }), flush=True)
+        "eff_vs_peak": round(eff, 3),
+    }
+    if eff > 1.1:
+        # physically impossible — the timed loop did not synchronize
+        line["invalid"] = "eff>110% of peak: timing not synchronized, discard"
+    print(json.dumps(line), flush=True)
+    return line
 
 
 def main():
