@@ -104,8 +104,11 @@ class ColumnParallelLinear(nn.Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if not self.gather_output:
-            # keep activation sharded on the feature dim
-            spec = P(*([None] * (out.ndim - 1) + ["mp"]))
+            # keep activation sharded on the feature dim; leading dims stay
+            # UNCONSTRAINED so the batch's (dp, sharding) sharding propagates
+            # (None would force-replicate them -> SPMD involuntary full
+            # rematerialization in the backward, round-4 weak #5)
+            spec = P(*([P.UNCONSTRAINED] * (out.ndim - 1) + ["mp"]))
             out = _constrain(out, spec)
         return out
 
@@ -132,7 +135,7 @@ class RowParallelLinear(nn.Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            spec = P(*([None] * (x.ndim - 1) + ["mp"]))
+            spec = P(*([P.UNCONSTRAINED] * (x.ndim - 1) + ["mp"]))
             x = _constrain(x, spec)
         return F.linear(x, self.weight, self.bias)
 
@@ -158,5 +161,5 @@ def mark_as_sequence_parallel(x: Tensor) -> Tensor:
     Megatron-SP's scatter (reference: fleet/utils/sequence_parallel_utils.py
     ScatterOp). GSPMD materializes the all-gather where full sequences are
     needed."""
-    spec = P(None, "mp", *([None] * (x.ndim - 2)))
+    spec = P(P.UNCONSTRAINED, "mp", *([P.UNCONSTRAINED] * (x.ndim - 2)))
     return _constrain(x, spec)

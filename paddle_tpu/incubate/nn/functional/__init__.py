@@ -595,17 +595,26 @@ def block_multihead_attention(
     - seq_lens_decoder: [B] tokens already in cache (decode offset)
     Mode is per-row: rows with seq_lens_encoder > 0 run prefill (causal over
     their prompt); rows with seq_lens_this_time == 1 run paged decode.
+
+    rope_emb ([2, B(or 1), max_seq, 1, head_dim//2] cos/sin) fuses rotary
+    application to q and the new k AT THE ABSOLUTE CACHE POSITION before the
+    cache write — the reference decode loop's fused cache-write+rope
+    (fused_multi_transformer_op.cu.h:3097). pre_key_cache/pre_value_cache
+    ([B, kv_heads, P, head_dim]) are a shared prefix every valid query
+    attends before the paged cache (reference pre_cache path).
     Returns (out [B, S, H*D], qkv, key_cache, value_cache) like the reference.
-    Quant/pre-cache paths are unsupported.
+    Activation-quant paths (qkv_out_scale/out_shift/out_smooth) are
+    unsupported.
     """
     from ....nn.functional._attn_math import masked_attention
 
-    if any(v is not None for v in (pre_key_cache, pre_value_cache,
-                                   qkv_out_scale, out_shift, out_smooth)):
-        raise NotImplementedError("block_multihead_attention pre-cache/"
-                                  "activation-quant paths are not supported "
-                                  "on TPU")
+    if any(v is not None for v in (qkv_out_scale, out_shift, out_smooth)):
+        raise NotImplementedError("block_multihead_attention activation-"
+                                  "quant paths are not supported on TPU")
     assert block_tables is not None, "block_tables is required"
+    if (pre_key_cache is None) != (pre_value_cache is None):
+        raise ValueError("pre_key_cache and pre_value_cache must be given "
+                         "together")
 
     _scales = (cache_k_quant_scales, cache_v_quant_scales,
                cache_k_dequant_scales, cache_v_dequant_scales)
@@ -624,12 +633,21 @@ def block_multihead_attention(
     has_bias = qkv_bias is not None
     if has_bias:
         ins.append(_t(qkv_bias))
+    has_rope = rope_emb is not None
+    if has_rope:
+        ins.append(_t(rope_emb))
+    has_pre = pre_key_cache is not None
+    if has_pre:
+        ins += [_t(pre_key_cache), _t(pre_value_cache)]
 
     def fn(qkv_v, kc, vc, enc_lens, dec_lens, tables, *rest):
         ri = iter(rest)
         if cache_quant:
             kqs, vqs, kdqs, vdqs = (next(ri) for _ in range(4))
         b = next(ri) if has_bias else None
+        rope = next(ri) if has_rope else None
+        pre_k = next(ri) if has_pre else None
+        pre_v = next(ri) if has_pre else None
         B, S = qkv_v.shape[0], qkv_v.shape[1]
         n_blocks, Hkv, bs, D = kc.shape
         HD3 = qkv_v.shape[-1]
@@ -643,9 +661,28 @@ def block_multihead_attention(
         enc_lens = enc_lens.reshape(B).astype(jnp.int32)
         dec_lens = dec_lens.reshape(B).astype(jnp.int32)
         offs = jnp.where(enc_lens > 0, 0, dec_lens)  # write offset per row
+        pos = offs[:, None] + jnp.arange(S)[None, :]          # [B, S] absolute
+
+        if rope is not None:
+            # fused rope at the ABSOLUTE cache position, applied to q and the
+            # new k before the write (reference decode loop fuses these:
+            # fused_multi_transformer_op.cu.h:3097)
+            ce, se = rope[0], rope[1]            # [B|1, max_seq, 1, D//2]
+            if ce.shape[0] == 1 and B > 1:
+                ce = jnp.broadcast_to(ce, (B,) + ce.shape[1:])
+                se = jnp.broadcast_to(se, (B,) + se.shape[1:])
+            gather_pos = jnp.minimum(pos, ce.shape[1] - 1)
+            ce = jnp.take_along_axis(
+                ce.astype(jnp.float32), gather_pos[:, :, None, None], axis=1)
+            se = jnp.take_along_axis(
+                se.astype(jnp.float32), gather_pos[:, :, None, None], axis=1)
+            # shared rotary math with fused_rotary_position_embedding —
+            # one implementation, conventions cannot drift
+            q = _apply_rope_one(q, ce[:, :, 0], se[:, :, 0], use_neox_style)
+            k_new = _apply_rope_one(k_new, ce[:, :, 0], se[:, :, 0],
+                                    use_neox_style)
 
         # ---- scatter new K/V into pages (invalid writes -> OOB page, drop) --
-        pos = offs[:, None] + jnp.arange(S)[None, :]          # [B, S] absolute
         page_idx = pos // bs
         slot = pos % bs
         page_ids = jnp.take_along_axis(
@@ -667,7 +704,7 @@ def block_multihead_attention(
         vc = vc.at[flat_pages, :, flat_slot].set(vn.astype(vc.dtype), mode="drop")
 
         total = offs + jnp.where(enc_lens > 0, enc_lens, 1)
-        if S == 1 and not cache_quant and _pallas_decode_on():
+        if S == 1 and not cache_quant and pre_k is None and _pallas_decode_on():
             # hot decode loop: paged Pallas kernel — block table resolved in
             # the BlockSpec index_map, no gathered cache copy materialized
             from ....ops.pallas.decode_attention import paged_decode_attention
@@ -690,6 +727,18 @@ def block_multihead_attention(
         kpos = jnp.arange(S_max)[None, :]
         keep = kpos[:, None, :] <= qpos[..., None]              # [B, S, S_max]
         keep = keep & (kpos[:, None, :] < total[:, None, None])
+        if pre_k is not None:
+            # shared prefix KV [B, Hkv, P, D]: logically BEFORE position 0,
+            # so every valid query row attends the whole prefix
+            P = pre_k.shape[2]
+            gk = jnp.concatenate(
+                [jnp.moveaxis(pre_k, 1, 2).astype(gk.dtype), gk], axis=1)
+            gv = jnp.concatenate(
+                [jnp.moveaxis(pre_v, 1, 2).astype(gv.dtype), gv], axis=1)
+            row_valid = (enc_lens > 0) | (dec_lens > 0)        # [B]
+            keep_pre = jnp.broadcast_to(
+                row_valid[:, None, None], (B, S, P))
+            keep = jnp.concatenate([keep_pre, keep], axis=-1)
         out = masked_attention(q, gk, gv, keep=keep[:, None])
         return (out.reshape(B, S, H * D).astype(qkv_v.dtype), qkv_v, kc, vc)
 

@@ -168,10 +168,13 @@ class GPTAttention(nn.Layer):
         q = self.q_proj(x).reshape([B, S, cfg.num_heads, cfg.head_dim])
         k = self.k_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
         v = self.v_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
-        # keep heads sharded over mp between the projections
-        q = _constrain(q, P(None, None, "mp", None))
-        k = _constrain(k, P(None, None, "mp", None))
-        v = _constrain(v, P(None, None, "mp", None))
+        # keep heads sharded over mp between the projections; batch dim
+        # UNCONSTRAINED so its (dp, sharding) sharding survives (None would
+        # force a replicate -> involuntary full remat in the backward)
+        _U = P.UNCONSTRAINED
+        q = _constrain(q, P(_U, None, "mp", None))
+        k = _constrain(k, P(_U, None, "mp", None))
+        v = _constrain(v, P(_U, None, "mp", None))
         if cfg.use_rope:
             q, k, _ = fused_rotary_position_embedding(
                 q, k, position_ids=position_ids,
@@ -193,9 +196,9 @@ class GPTAttention(nn.Layer):
             assert cfg.attention_dropout_prob == 0.0, (
                 "context_parallel ring attention does not support attention "
                 "dropout; set attention_dropout_prob=0")
-            q = _constrain(q, P(None, "sep", "mp", None))
-            k = _constrain(k, P(None, "sep", "mp", None))
-            v = _constrain(v, P(None, "sep", "mp", None))
+            q = _constrain(q, P(_U, "sep", "mp", None))
+            k = _constrain(k, P(_U, "sep", "mp", None))
+            v = _constrain(v, P(_U, "sep", "mp", None))
             out = F.ring_flash_attention(q, k, v, causal=True)
         elif cfg.attn_variant == "flashmask":
             assert cfg.attention_dropout_prob == 0.0, (
@@ -421,7 +424,8 @@ class GPTForCausalLM(nn.Layer):
         if self.config.tie_word_embeddings:
             w = self.gpt.embed_tokens.weight
             logits = run_op("lm_head_tied", lambda a, ww: jnp.matmul(a, ww.T), [h, w])
-            logits = _constrain(logits, P(None, None, "mp"))
+            logits = _constrain(
+                logits, P(P.UNCONSTRAINED, P.UNCONSTRAINED, "mp"))
         else:
             logits = self.lm_head(h)
         if caches is not None:

@@ -249,7 +249,7 @@ class GPTForCausalLMPipe(nn.Layer):
         if cfg.tie_word_embeddings:
             w = self.embed_tokens.weight
             logits = run_op("lm_head_tied", lambda a, ww: jnp.matmul(a, ww.T), [h, w])
-            logits = _constrain(logits, P(None, None, "mp"))
+            logits = _constrain(logits, P(P.UNCONSTRAINED, P.UNCONSTRAINED, "mp"))
         else:
             logits = self.lm_head(h)
         return logits
@@ -303,7 +303,7 @@ class GPTForCausalLMPipe(nn.Layer):
                     logits = jnp.matmul(h_n, lp["head"].T)
                 else:
                     logits = jnp.matmul(h_n, lp["head"])
-                logits = _constrain(logits, P(None, None, "mp"))
+                logits = _constrain(logits, P(P.UNCONSTRAINED, P.UNCONSTRAINED, "mp"))
                 loss = criterion(Tensor(logits), Tensor(labels))
                 return loss._value.astype(jnp.float32)
 

@@ -169,6 +169,126 @@ class TestBlockAttention:
         err = np.abs(out.numpy() - ref.numpy()).max()
         assert err < 0.05 * np.abs(ref.numpy()).max() + 1e-2, err
 
+    def test_rope_fused_prefill_matches_manual(self):
+        """rope_emb fuses rotary into q/k before the cache write
+        (reference: fused_multi_transformer_op.cu.h:3097 decode loop)."""
+        rng = np.random.RandomState(5)
+        B, Hq, Hkv, D, bs = 2, 2, 2, 8, 4
+        S, max_seq = 4, 16
+        qkv = rng.randn(B, S, (Hq + 2 * Hkv) * D).astype(np.float32)
+        tables = np.array([[0, 1, -1, -1], [2, 3, -1, -1]], np.int32)
+        inv = 1.0 / (10000.0 ** (np.arange(0, D, 2) / D))
+        ang = np.arange(max_seq)[:, None] * inv[None, :]     # [max_seq, D/2]
+        rope = np.stack([np.cos(ang), np.sin(ang)])[:, None, :, None, :]
+        rope = np.broadcast_to(rope, (2, B, max_seq, 1, D // 2)).astype(np.float32)
+        kc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        vc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        out, _, kc2, _ = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), kc, vc,
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            paddle.to_tensor(np.zeros((B,), np.int32)),
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            block_tables=paddle.to_tensor(tables), block_size=bs,
+            rope_emb=paddle.to_tensor(rope), use_neox_style=True)
+
+        # manual: rotate q/k (neox half-split), then causal attention
+        q3 = qkv.reshape(B, S, Hq + 2 * Hkv, D)
+        q, k, v = q3[:, :, :Hq], q3[:, :, Hq:Hq + Hkv], q3[:, :, Hq + Hkv:]
+
+        def rot(x):
+            c = np.cos(ang)[None, :S, None, :]
+            s_ = np.sin(ang)[None, :S, None, :]
+            x1, x2 = x[..., :D // 2], x[..., D // 2:]
+            return np.concatenate([x1 * c - x2 * s_, x2 * c + x1 * s_], -1)
+
+        qr, kr_ = rot(q), rot(k)
+        logits = np.einsum("bshd,bthd->bhst", qr, kr_) / np.sqrt(D)
+        logits = np.where(np.tril(np.ones((S, S), bool))[None, None],
+                          logits, -1e30)
+        ref = np.einsum("bhst,bthd->bshd", _softmax(logits), v)
+        np.testing.assert_allclose(
+            out.numpy().reshape(B, S, Hq, D), ref, rtol=1e-5, atol=1e-5)
+        # the CACHE must hold rotated keys (write-after-rope, like the fused
+        # kernel) — page 0 slot 0 is batch 0 position 0
+        np.testing.assert_allclose(kc2.numpy()[0, :, 0],
+                                   kr_[0, 0], rtol=1e-5, atol=1e-5)
+
+    def test_pre_cache_prefix_attended(self):
+        """pre_key/value_cache: a shared prefix every query attends before
+        the paged cache (reference pre_cache path)."""
+        rng = np.random.RandomState(7)
+        B, Hq, Hkv, D, bs, P = 2, 2, 2, 8, 4, 3
+        S = 4
+        qkv = rng.randn(B, S, (Hq + 2 * Hkv) * D).astype(np.float32)
+        pre_k = rng.randn(B, Hkv, P, D).astype(np.float32)
+        pre_v = rng.randn(B, Hkv, P, D).astype(np.float32)
+        tables = np.array([[0, 1, -1, -1], [2, 3, -1, -1]], np.int32)
+        kc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        vc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        out, _, _, _ = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), kc, vc,
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            paddle.to_tensor(np.zeros((B,), np.int32)),
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            block_tables=paddle.to_tensor(tables), block_size=bs,
+            pre_key_cache=paddle.to_tensor(pre_k),
+            pre_value_cache=paddle.to_tensor(pre_v))
+        q3 = qkv.reshape(B, S, Hq + 2 * Hkv, D)
+        q, k, v = q3[:, :, :Hq], q3[:, :, Hq:Hq + Hkv], q3[:, :, Hq + Hkv:]
+        k_all = np.concatenate([np.moveaxis(pre_k, 1, 2), k], 1)  # [B,P+S,..]
+        v_all = np.concatenate([np.moveaxis(pre_v, 1, 2), v], 1)
+        logits = np.einsum("bshd,bthd->bhst", q, k_all) / np.sqrt(D)
+        # prefix always visible; cache part causal
+        keep = np.concatenate(
+            [np.ones((S, P), bool), np.tril(np.ones((S, S), bool))], -1)
+        logits = np.where(keep[None, None], logits, -1e30)
+        ref = np.einsum("bhst,bthd->bshd", _softmax(logits), v_all)
+        np.testing.assert_allclose(
+            out.numpy().reshape(B, S, Hq, D), ref, rtol=1e-5, atol=1e-5)
+
+    def test_pre_cache_decode_step(self):
+        """Decode (S=1) with a prefix cache: new token attends prefix + all
+        cached tokens + itself."""
+        rng = np.random.RandomState(9)
+        B, Hq, Hkv, D, bs, P = 1, 2, 2, 8, 4, 2
+        S = 3
+        tables = np.array([[0, 1, -1, -1]], np.int32)
+        pre_k = rng.randn(B, Hkv, P, D).astype(np.float32)
+        pre_v = rng.randn(B, Hkv, P, D).astype(np.float32)
+        qkv = rng.randn(B, S, (Hq + 2 * Hkv) * D).astype(np.float32)
+        kc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        vc = paddle.to_tensor(np.zeros((8, Hkv, bs, D), np.float32))
+        _, _, kc2, vc2 = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), kc, vc,
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            paddle.to_tensor(np.zeros((B,), np.int32)),
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            block_tables=paddle.to_tensor(tables), block_size=bs,
+            pre_key_cache=paddle.to_tensor(pre_k),
+            pre_value_cache=paddle.to_tensor(pre_v))
+        qkv_d = rng.randn(B, 1, (Hq + 2 * Hkv) * D).astype(np.float32)
+        out_d, _, _, _ = IF.block_multihead_attention(
+            paddle.to_tensor(qkv_d), kc2, vc2,
+            paddle.to_tensor(np.zeros((B,), np.int32)),
+            paddle.to_tensor(np.full((B,), S, np.int32)),
+            paddle.to_tensor(np.ones((B,), np.int32)),
+            block_tables=paddle.to_tensor(tables), block_size=bs,
+            pre_key_cache=paddle.to_tensor(pre_k),
+            pre_value_cache=paddle.to_tensor(pre_v))
+        q3 = qkv.reshape(B, S, Hq + 2 * Hkv, D)
+        qd3 = qkv_d.reshape(B, 1, Hq + 2 * Hkv, D)
+        qd = qd3[:, :, :Hq]
+        k_all = np.concatenate(
+            [np.moveaxis(pre_k, 1, 2), q3[:, :, Hq:Hq + Hkv],
+             qd3[:, :, Hq:Hq + Hkv]], 1)
+        v_all = np.concatenate(
+            [np.moveaxis(pre_v, 1, 2), q3[:, :, Hq + Hkv:],
+             qd3[:, :, Hq + Hkv:]], 1)
+        logits = np.einsum("bshd,bthd->bhst", qd, k_all) / np.sqrt(D)
+        ref_d = np.einsum("bhst,bthd->bshd", _softmax(logits), v_all)
+        np.testing.assert_allclose(
+            out_d.numpy().reshape(B, 1, Hq, D), ref_d, rtol=1e-5, atol=1e-5)
+
     def test_blha_get_max_len(self):
         e, d = IF.blha_get_max_len(
             paddle.to_tensor(np.array([3, 9, 1], np.int32)),
