@@ -1,6 +1,7 @@
 from . import callbacks
-from .callbacks import Callback, EarlyStopping, ModelCheckpoint, ProgBarLogger
+from .callbacks import (Callback, EarlyStopping, ModelCheckpoint,
+                        ProgBarLogger, ReduceLROnPlateau, VisualDL)
 from .dynamic_flops import flops
 from .model import Model, summary
 
-__all__ = ["Model", "summary", "flops", "callbacks", "Callback", "EarlyStopping", "ModelCheckpoint", "ProgBarLogger"]
+__all__ = ["Model", "summary", "flops", "callbacks", "Callback", "EarlyStopping", "ModelCheckpoint", "ProgBarLogger", "ReduceLROnPlateau", "VisualDL"]

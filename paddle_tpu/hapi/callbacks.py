@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import json
 import os
 import time
 
@@ -182,3 +183,145 @@ class LRScheduler(Callback):
             s = self._sched()
             if s:
                 s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer lr when the monitored metric plateaus
+    (reference: hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.epoch = 0
+        self._last_epoch_stepped = None
+
+    def _improved(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def _step(self, logs):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        # fit() can surface the monitored key in BOTH the epoch logs and the
+        # eval logs — only ONE observation per epoch may advance the plateau
+        # counter, or patience halves and the factor applies twice
+        if self._last_epoch_stepped == self.epoch:
+            return
+        self._last_epoch_stepped = self.epoch
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            from ..optimizer.lr import LRScheduler as Sched
+
+            if isinstance(opt._lr, Sched):
+                # scale the schedule's BASE lr — writing last_lr*factor into
+                # base_lr would re-apply the schedule multiplier on top of
+                # the already-scaled value
+                old = float(opt._lr.base_lr)
+                new = max(old * self.factor, self.min_lr)
+                opt._lr.base_lr = new
+            else:
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                opt.set_lr(new)
+            if self.verbose:
+                print(f"Epoch {self.epoch}: ReduceLROnPlateau reducing "
+                      f"learning rate from {old} to {new}.")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epoch = epoch
+        self._step(logs)
+
+    def on_eval_end(self, logs=None):
+        self._step(logs)
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: hapi/callbacks.py VisualDL).
+
+    The VisualDL package is not available on this stack; scalars are
+    appended as JSON lines under log_dir (one file per phase) — readable by
+    any dashboard and by tests. If the visualdl package IS importable, its
+    LogWriter is used instead.
+    """
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self.epoch = 0
+        self._writer = None
+        self._jsonl = None
+        try:  # pragma: no cover - visualdl not in this image
+            from visualdl import LogWriter
+
+            self._writer = LogWriter(log_dir)
+        except Exception:
+            os.makedirs(log_dir, exist_ok=True)
+
+    def _record(self, phase, logs, step):
+        logs = logs or {}
+        lines = []
+        for k, v in logs.items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if not isinstance(v, (int, float, np.floating, np.integer)):
+                continue
+            if self._writer is not None:  # pragma: no cover
+                self._writer.add_scalar(f"{phase}/{k}", float(v), step)
+            else:
+                lines.append(json.dumps({"tag": k, "step": int(step),
+                                         "value": float(v)}))
+        if lines:
+            path = os.path.join(self.log_dir, f"{phase}.jsonl")
+            with open(path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._record("train", logs, step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epoch = epoch
+        self._record("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._record("eval", logs, self.epoch)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:  # pragma: no cover
+            self._writer.close()
+
+
+__all__ += ["ReduceLROnPlateau", "VisualDL"]

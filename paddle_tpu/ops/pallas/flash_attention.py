@@ -73,6 +73,25 @@ def _block_sizes(sq, skv, d=None):
 # --------------------------------------------------------------------------- #
 
 
+def _block_mask(q_start, k_start, bq, bk, off, causal, pad_k, skv,
+                pad_q=False, sq=None):
+    """Bool keep-mask for one [bq, bk] tile, built from 1-D iotas broadcast
+    against each other (a 2-D iota per operand costs two full VPU
+    materializations; the broadcast compare is one)."""
+    row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    col = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    mask = None
+    if causal:
+        mask = col <= row + off
+    if pad_k:  # kv padding tail (only when skv % bk != 0)
+        m2 = jnp.broadcast_to(col < skv, (bq, bk))
+        mask = m2 if mask is None else mask & m2
+    if pad_q and sq is not None:  # q padding tail (dkv kernel)
+        m3 = jnp.broadcast_to(row < sq, (bq, bk))
+        mask = m3 if mask is None else mask & m3
+    return mask
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, scale, causal, sq, skv, bq, bk, nk):
@@ -84,6 +103,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # bottom-right-aligned causal (flash-attn convention): query at true row r
     # attends to cols <= r + (skv - sq), so decode (sq=1) sees the whole cache
     off = skv - sq
+    pad_k = (skv % bk) != 0  # static: no padding -> no padding mask at all
 
     @pl.when(j == 0)
     def _init():
@@ -91,37 +111,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: block needed iff k_start <= q_end + off
-    needed = True
-    if causal:
-        needed = k_start <= q_start + bq - 1 + off
-
-    @pl.when(needed if causal else j >= 0)
-    def _compute():
-        # feed the MXU its native input dtype (bf16 under AMP — one pass vs
-        # the six passes an f32xf32 product costs); accumulation is f32 via
-        # preferred_element_type either way
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
-
-        row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        col = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = col < skv  # kv padding
-        if causal:
-            mask = mask & (col <= row + off)
-        s = jnp.where(mask, s, NEG_INF)
-
+    def _online_update(s, v):
         m_prev = m_scr[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)  # [bq, bk]
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-
-        v = v_ref[0, 0]
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
@@ -129,6 +125,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    def _logits():
+        # feed the MXU its native input dtype (bf16 under AMP — one pass vs
+        # the six passes an f32xf32 product costs); accumulation is f32 via
+        # preferred_element_type either way
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        return jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+
+    if causal:
+        # three-way block split: interior blocks (fully below the diagonal)
+        # skip ALL mask work — only diagonal-crossing blocks pay for it
+        interior = k_start + bk - 1 <= q_start + off
+        diagonal = (~interior) & (k_start <= q_start + bq - 1 + off)
+
+        @pl.when(interior if not pad_k else interior & (j < nk - 1))
+        def _compute_interior():
+            _online_update(_logits(), v_ref[0, 0])
+
+        @pl.when(diagonal if not pad_k else diagonal | ((j == nk - 1)
+                                                        & (k_start <= q_start + bq - 1 + off)))
+        def _compute_diagonal():
+            s = _logits()
+            mask = _block_mask(q_start, k_start, bq, bk, off, True, pad_k, skv)
+            _online_update(jnp.where(mask, s, NEG_INF), v_ref[0, 0])
+    elif pad_k:
+        @pl.when(j < nk - 1)
+        def _compute_inner():
+            _online_update(_logits(), v_ref[0, 0])
+
+        @pl.when(j == nk - 1)
+        def _compute_tail():
+            s = _logits()
+            mask = _block_mask(q_start, k_start, bq, bk, off, False, True, skv)
+            _online_update(jnp.where(mask, s, NEG_INF), v_ref[0, 0])
+    else:
+        _online_update(_logits(), v_ref[0, 0])
 
     # last block for this row: nk-1 in general; for causal the last needed one
     if causal:
@@ -199,14 +234,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     k_start = j * bk
     off = skv - sq
 
+    pad_k = (skv % bk) != 0
+
     @pl.when(j == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    needed = k_start <= q_start + bq - 1 + off if causal else j >= 0
-
-    @pl.when(needed)
-    def _compute():
+    def _accum(masked):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -217,12 +251,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        col = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = col < skv
-        if causal:
-            mask = mask & (col <= row + off)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - lse)
+        if masked:
+            mask = _block_mask(q_start, k_start, bq, bk, off, causal, pad_k,
+                               skv)
+            if mask is not None:
+                p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -230,6 +264,30 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+
+    if causal:
+        interior = k_start + bk - 1 <= q_start + off
+        needed = k_start <= q_start + bq - 1 + off
+        if pad_k:
+            interior = interior & (j < nk - 1)
+
+        @pl.when(interior)
+        def _compute_interior():
+            _accum(masked=False)
+
+        @pl.when(needed & ~interior)
+        def _compute_masked():
+            _accum(masked=True)
+    elif pad_k:
+        @pl.when(j < nk - 1)
+        def _compute_inner():
+            _accum(masked=False)
+
+        @pl.when(j == nk - 1)
+        def _compute_tail():
+            _accum(masked=True)
+    else:
+        _accum(masked=False)
 
     if causal:
         last = jnp.clip((q_start + bq - 1 + off) // bk, 0, nk - 1)
@@ -250,16 +308,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = j * bk
     off = skv - sq
 
+    pad_k = (skv % bk) != 0
+    pad_q = (sq % bq) != 0
+
     @pl.when(i == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    # causal: q block needed iff q_end + off >= k_start
-    needed = q_start + bq - 1 + off >= k_start if causal else i >= 0
-
-    @pl.when(needed)
-    def _compute():
+    def _accum(masked):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -270,12 +327,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        row = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        col = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = (col < skv) & (row < sq)
-        if causal:
-            mask = mask & (col <= row + off)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk]
+        if masked:
+            mask = _block_mask(q_start, k_start, bq, bk, off, causal, pad_k,
+                               skv, pad_q=pad_q, sq=sq)
+            if mask is not None:
+                p = jnp.where(mask, p, 0.0)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
@@ -287,6 +344,40 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+
+    # causal: q block needed iff q_end + off >= k_start; interior q blocks
+    # (whole block past the diagonal) need no causal mask
+    if causal:
+        interior = k_start + bk - 1 <= q_start + off
+        needed = q_start + bq - 1 + off >= k_start
+        if pad_k:
+            interior = interior & (j < pl.num_programs(2) - 1)
+        if pad_q:
+            interior = interior & (i < nq - 1)
+
+        @pl.when(interior)
+        def _compute_interior():
+            _accum(masked=False)
+
+        @pl.when(needed & ~interior)
+        def _compute_masked():
+            _accum(masked=True)
+    elif pad_k or pad_q:
+        tail = jnp.bool_(False)
+        if pad_k:
+            tail = tail | (j == pl.num_programs(2) - 1)
+        if pad_q:
+            tail = tail | (i == nq - 1)
+
+        @pl.when(~tail)
+        def _compute_inner():
+            _accum(masked=False)
+
+        @pl.when(tail)
+        def _compute_tail():
+            _accum(masked=True)
+    else:
+        _accum(masked=False)
 
     @pl.when(i == nq - 1)
     def _finish():

@@ -361,8 +361,10 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail: bool = True,
                 thread_sep: bool = False, time_unit: str = "ms",
                 views=None) -> str:
-        """Aggregated per-op table (reference :883 backed by
-        profiler_statistic.py)."""
+        """Overview + per-op host tables + device Kernel Summary parsed from
+        the captured XLA trace (reference :883 backed by
+        profiler_statistic.py's overview/operator/kernel tables)."""
         from .statistic import build_summary
 
-        return build_summary(self._events, time_unit=time_unit)
+        return build_summary(self._events, time_unit=time_unit,
+                             device_trace_dir=self.device_trace_dir)

@@ -210,7 +210,7 @@ class BrightnessTransform(BaseTransform):
 
     def _apply_image(self, img):
         f = 1 + np.random.uniform(-self.value, self.value)
-        return np.clip(img.astype(np.float32) * f, 0, 255 if img.dtype == np.uint8 else None).astype(img.dtype)
+        return adjust_brightness(img, f)
 
 
 class ContrastTransform(BaseTransform):
@@ -219,5 +219,388 @@ class ContrastTransform(BaseTransform):
 
     def _apply_image(self, img):
         f = 1 + np.random.uniform(-self.value, self.value)
-        mean = img.mean()
-        return np.clip((img.astype(np.float32) - mean) * f + mean, 0, 255 if img.dtype == np.uint8 else None).astype(img.dtype)
+        return adjust_contrast(img, f)
+
+
+# --------------------------------------------------------------------------- #
+# functional tail (reference: python/paddle/vision/transforms/functional.py)
+# --------------------------------------------------------------------------- #
+
+
+def _is_chw(img):
+    return img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[2] > 4
+
+
+def _clip_like(out, ref):
+    if ref.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255.0).astype(np.uint8)
+    return out.astype(ref.dtype)
+
+
+def adjust_brightness(img, factor):
+    return _clip_like(img.astype(np.float32) * factor, img)
+
+
+def adjust_contrast(img, factor):
+    f = img.astype(np.float32)
+    mean = to_grayscale(img).astype(np.float32).mean()
+    return _clip_like((f - mean) * factor + mean, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ITU-R 601-2 luma (reference functional.to_grayscale). HWC in."""
+    f = img.astype(np.float32)
+    if img.ndim == 2:
+        g = f
+    else:
+        g = f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
+    g = g[..., None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=-1)
+    return g.astype(img.dtype) if img.dtype == np.uint8 else g
+
+
+def adjust_saturation(img, factor):
+    f = img.astype(np.float32)
+    gray = to_grayscale(img, 3).astype(np.float32)
+    return _clip_like(gray + (f - gray) * factor, img)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) through HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    f = img.astype(np.float32) / (255.0 if img.dtype == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx, mn = f[..., :3].max(-1), f[..., :3].min(-1)
+    diff = mx - mn + 1e-12
+    h = np.where(mx == r, ((g - b) / diff) % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6.0
+    h = (h + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    i = np.floor(h * 6).astype(np.int32) % 6
+    frac = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - frac * s)
+    t = v * (1 - (1 - frac) * s)
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], -1)
+    if img.dtype == np.uint8:
+        out = out * 255.0
+    return _clip_like(out, img)
+
+
+def _warp(img, inv33, fill=0.0, perspective=False, method="bilinear",
+          out_hw=None):
+    """Inverse-map warp with bilinear or nearest sampling; img HWC (or HW).
+    out_hw sets the output canvas size (rotate(expand=True))."""
+    squeeze = img.ndim == 2
+    if squeeze:
+        img = img[..., None]
+    H, W, C = img.shape
+    Ho, Wo = out_hw if out_hw is not None else (H, W)
+    ys, xs = np.meshgrid(np.arange(Ho, dtype=np.float64),
+                         np.arange(Wo, dtype=np.float64), indexing="ij")
+    ones = np.ones_like(xs)
+    src = inv33 @ np.stack([xs.ravel(), ys.ravel(), ones.ravel()])
+    if perspective:
+        sx = src[0] / (src[2] + 1e-12)
+        sy = src[1] / (src[2] + 1e-12)
+    else:
+        sx, sy = src[0], src[1]
+    sx = sx.reshape(Ho, Wo)
+    sy = sy.reshape(Ho, Wo)
+    if method == "nearest":
+        sx = np.floor(sx + 0.5)
+        sy = np.floor(sy + 0.5)
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    wx = (sx - x0)[..., None]
+    wy = (sy - y0)[..., None]
+    valid = (sx >= -1) & (sx <= W) & (sy >= -1) & (sy <= H)
+
+    def tap(yy, xx):
+        inside = (xx >= 0) & (xx < W) & (yy >= 0) & (yy < H)
+        v = img[np.clip(yy, 0, H - 1), np.clip(xx, 0, W - 1)].astype(np.float64)
+        return np.where(inside[..., None], v, fill)
+
+    out = ((1 - wx) * (1 - wy) * tap(y0, x0)
+           + wx * (1 - wy) * tap(y0, x0 + 1)
+           + (1 - wx) * wy * tap(y0 + 1, x0)
+           + wx * wy * tap(y0 + 1, x0 + 1))
+    out = np.where(valid[..., None], out, fill)
+    out = _clip_like(out, img)
+    return out[..., 0] if squeeze else out
+
+
+def _affine_inv_matrix(center, angle, translate, scale, shear):
+    """Inverse of the paddle affine matrix (center-rotate-shear-scale +
+    translate; reference functional.affine)."""
+    cx, cy = center
+    # positive angle = counter-clockwise on screen (torchvision/paddle
+    # convention); with image y pointing down that is a negative math angle
+    rot = np.deg2rad(-angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward: T(translate) . C . R(rot) . Shear . Scale . C^-1
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]])
+    pre = np.array([[1, 0, cx + translate[0]],
+                    [0, 1, cy + translate[1]],
+                    [0, 0, 1]], dtype=np.float64)
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], dtype=np.float64)
+    fwd = pre @ m @ post
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    H, W = img.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    inv = _affine_inv_matrix(center, angle, translate, scale, shear)
+    return _warp(img, inv, fill=fill, method=interpolation)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    if not expand:
+        return affine(img, angle=angle, center=center, fill=fill,
+                      interpolation=interpolation)
+    H, W = img.shape[:2]
+    rad = np.deg2rad(angle)
+    ca, sa = abs(np.cos(rad)), abs(np.sin(rad))
+    # round, not ceil: cos(90deg) is ~6e-17, and ceil would grow the canvas
+    # by a spurious pixel on exact right-angle rotations
+    Wo = int(np.floor(W * ca + H * sa + 0.5))
+    Ho = int(np.floor(W * sa + H * ca + 0.5))
+    cin = ((W - 1) * 0.5, (H - 1) * 0.5) if center is None else center
+    cout = ((Wo - 1) * 0.5, (Ho - 1) * 0.5)
+    # inverse map: recentre output, rotate back (y-down => +angle), shift in
+    r = np.deg2rad(angle)
+    rinv = np.array([[np.cos(r), -np.sin(r), 0],
+                     [np.sin(r), np.cos(r), 0],
+                     [0, 0, 1]], dtype=np.float64)
+    t_in = np.array([[1, 0, cin[0]], [0, 1, cin[1]], [0, 0, 1]], np.float64)
+    t_out = np.array([[1, 0, -cout[0]], [0, 1, -cout[1]], [0, 0, 1]],
+                     np.float64)
+    inv = t_in @ rinv @ t_out
+    return _warp(img, inv, fill=fill, method=interpolation, out_hw=(Ho, Wo))
+
+
+def _homography(src_pts, dst_pts):
+    """3x3 mapping src->dst from 4 point pairs (least squares)."""
+    A, bv = [], []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        bv.append(u)
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        bv.append(v)
+    h = np.linalg.lstsq(np.asarray(A, np.float64),
+                        np.asarray(bv, np.float64), rcond=None)[0]
+    return np.append(h, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear", fill=0):
+    """Warp so that startpoints map to endpoints (reference
+    functional.perspective)."""
+    fwd = _homography(startpoints, endpoints)
+    return _warp(img, np.linalg.inv(fwd), fill=fill, perspective=True)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase region [i:i+h, j:j+w] with value v (reference functional.erase).
+    Accepts HWC or CHW."""
+    out = img if inplace else img.copy()
+    if _is_chw(out):
+        vv = np.asarray(v)
+        if vv.ndim == 1:  # per-channel fill must broadcast along C, not w
+            vv = vv.reshape(-1, 1, 1)
+        out[:, i:i + h, j:j + w] = vv
+    else:
+        out[i:i + h, j:j + w] = v
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# transform classes (reference: python/paddle/vision/transforms/transforms.py)
+# --------------------------------------------------------------------------- #
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly jitter brightness/contrast/saturation/hue in random order
+    (reference transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        for idx in np.random.permutation(len(self.transforms)):
+            img = self.transforms[idx](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if np.isscalar(degrees):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.center = center
+        self.fill = fill
+        self.interpolation = interpolation
+        self.expand = expand
+
+    def _apply_image(self, img):
+        a = np.random.uniform(*self.degrees)
+        return rotate(img, a, center=self.center, fill=self.fill,
+                      interpolation=self.interpolation, expand=self.expand)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if np.isscalar(degrees):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        H, W = img.shape[:2]
+        a = np.random.uniform(*self.degrees)
+        t = (0.0, 0.0)
+        if self.translate is not None:
+            t = (np.random.uniform(-self.translate[0], self.translate[0]) * W,
+                 np.random.uniform(-self.translate[1], self.translate[1]) * H)
+        s = 1.0
+        if self.scale is not None:
+            s = np.random.uniform(*self.scale)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shear = self.shear
+            if np.isscalar(shear):
+                shear = (-abs(shear), abs(shear))
+            if len(shear) == 2:
+                sh = (np.random.uniform(*shear), 0.0)
+            else:
+                sh = (np.random.uniform(shear[0], shear[1]),
+                      np.random.uniform(shear[2], shear[3]))
+        return affine(img, angle=a, translate=t, scale=s, shear=sh,
+                      fill=self.fill, center=self.center,
+                      interpolation=self.interpolation)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        H, W = img.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * W / 2), int(d * H / 2)
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (W - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (W - 1 - np.random.randint(0, dx + 1),
+                H - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                H - 1 - np.random.randint(0, dy + 1))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Erase a random rectangle (reference transforms.RandomErasing; Zhong
+    et al. 2017). Works on HWC or CHW arrays."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        chw = _is_chw(img)
+        H, W = (img.shape[1], img.shape[2]) if chw else img.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < H and w < W and h > 0 and w > 0:
+                i = np.random.randint(0, H - h + 1)
+                j = np.random.randint(0, W - w + 1)
+                v = (np.random.standard_normal(
+                        ((img.shape[0],) if chw else (img.shape[-1],))
+                    ).astype(np.float32) if self.value == "random"
+                    else self.value)
+                return erase(img, i, j, h, w, v, inplace=self.inplace)
+        return img
+
+
+__all__ += [
+    "SaturationTransform", "HueTransform", "ColorJitter", "Grayscale",
+    "RandomRotation", "RandomAffine", "RandomPerspective", "RandomErasing",
+    "adjust_brightness", "adjust_contrast", "adjust_saturation", "adjust_hue",
+    "to_grayscale", "affine", "rotate", "perspective", "erase",
+]

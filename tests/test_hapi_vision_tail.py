@@ -1,0 +1,237 @@
+"""hapi callback zoo tail + vision transforms tail (round-5: VERDICT
+missing #5/#6 — reference python/paddle/hapi/callbacks.py and
+python/paddle/vision/transforms/)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import ReduceLROnPlateau, VisualDL
+from paddle_tpu.vision import transforms as T
+
+
+class _Const:
+    """Reusable tiny dataset: x -> 2x."""
+
+    def __iter__(self):
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            x = rng.normal(size=(8, 4)).astype(np.float32)
+            yield paddle.to_tensor(x), paddle.to_tensor(2 * x)
+
+
+class TestCallbacksTail:
+    def _model(self, lr=0.1):
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        m = Model(net)
+        optimizer = opt.SGD(learning_rate=lr, parameters=net.parameters())
+        m.prepare(optimizer, nn.MSELoss())
+        return m, optimizer
+
+    def test_reduce_lr_on_plateau_cuts_lr(self):
+        m, optimizer = self._model(lr=0.1)
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0)
+        cb.set_model(m)
+        # flat loss: first epoch sets best; each later epoch waits, reduce
+        # fires when wait hits patience
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})
+        assert optimizer.get_lr() == pytest.approx(0.05)
+        # improvement resets the wait counter
+        cb.on_epoch_end(2, {"loss": 0.5})
+        cb.on_epoch_end(3, {"loss": 0.5})
+        assert optimizer.get_lr() == pytest.approx(0.025)
+
+    def test_reduce_lr_respects_min_lr(self):
+        m, optimizer = self._model(lr=0.1)
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.1, patience=0,
+                               min_lr=0.05, verbose=0)
+        cb.set_model(m)
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})
+        cb.on_epoch_end(2, {"loss": 1.0})
+        assert optimizer.get_lr() == pytest.approx(0.05)
+
+    def test_visualdl_writes_scalars(self, tmp_path):
+        m, _ = self._model()
+        cb = VisualDL(log_dir=str(tmp_path))
+        cb.set_model(m)
+        cb.on_train_batch_end(0, {"loss": 1.5})
+        cb.on_train_batch_end(1, {"loss": [1.25]})
+        cb.on_epoch_end(0, {"loss": 1.0, "non_scalar": "skip-me"})
+        cb.on_eval_end({"loss": 0.75})
+        train = [json.loads(l) for l in
+                 open(os.path.join(tmp_path, "train.jsonl"))]
+        assert [r["value"] for r in train] == [1.5, 1.25]
+        ep = [json.loads(l) for l in
+              open(os.path.join(tmp_path, "train_epoch.jsonl"))]
+        assert ep[0]["value"] == 1.0 and len(ep) == 1  # non-scalar skipped
+        ev = [json.loads(l) for l in
+              open(os.path.join(tmp_path, "eval.jsonl"))]
+        assert ev[0]["value"] == 0.75
+
+    def test_fit_with_tail_callbacks(self, tmp_path):
+        """The new callbacks survive a real Model.fit loop."""
+        m, optimizer = self._model(lr=0.05)
+        cbs = [ReduceLROnPlateau(monitor="loss", patience=100, verbose=0),
+               VisualDL(log_dir=str(tmp_path))]
+        m.fit(_Const(), epochs=2, callbacks=cbs, verbose=0)
+        assert os.path.exists(os.path.join(tmp_path, "train.jsonl"))
+
+
+class TestTransformsTail:
+    def _img(self, h=16, w=20):
+        return (np.random.RandomState(0).rand(h, w, 3) * 255).astype(np.uint8)
+
+    def test_affine_identity_and_rotate_conventions(self):
+        img = self._img()
+        assert np.array_equal(T.affine(img, angle=0), img)
+        sq = self._img(17, 17)
+        # positive angle = counter-clockwise (torchvision/paddle convention)
+        assert np.abs(T.rotate(sq, 90).astype(int)
+                      - np.rot90(sq, 1).astype(int)).max() <= 1
+        assert np.abs(T.rotate(sq, 180).astype(int)
+                      - sq[::-1, ::-1].astype(int)).max() <= 1
+
+    def test_affine_translate_scale(self):
+        img = self._img()
+        # translate by (2, 3): out[y, x] == img[y-3, x-2]
+        out = T.affine(img, translate=(2, 3))
+        assert np.array_equal(out[5:, 4:], img[2:-3, 2:-2])
+
+    def test_perspective_identity_and_warp(self):
+        img = self._img()
+        H, W = img.shape[:2]
+        corners = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        assert np.array_equal(T.perspective(img, corners, corners), img)
+        # a real distortion changes pixels but stays in range
+        end = [(2, 1), (W - 2, 2), (W - 1, H - 2), (1, H - 1)]
+        out = T.perspective(img, corners, end)
+        assert out.shape == img.shape and not np.array_equal(out, img)
+
+    def test_color_ops(self):
+        img = self._img()
+        assert np.array_equal(T.adjust_brightness(img, 1.0), img)
+        bright = T.adjust_brightness(img, 2.0)
+        assert bright.astype(int).sum() > img.astype(int).sum()
+        # saturation 0 == grayscale
+        gray = T.adjust_saturation(img, 0.0)
+        g3 = T.to_grayscale(img, 3)
+        assert np.abs(gray.astype(int) - g3.astype(int)).max() <= 1
+        # hue round-trips
+        h2 = T.adjust_hue(T.adjust_hue(img, 0.3), -0.3)
+        assert np.abs(h2.astype(int) - img.astype(int)).max() <= 3
+        with pytest.raises(ValueError):
+            T.adjust_hue(img, 0.7)
+
+    def test_random_transforms_shapes_and_determinism(self):
+        img = self._img()
+        np.random.seed(0)
+        for t in (T.ColorJitter(0.4, 0.4, 0.4, 0.4), T.RandomRotation(30),
+                  T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                                 shear=5.0),
+                  T.RandomPerspective(prob=1.0), T.Grayscale(3)):
+            out = t(img)
+            assert out.shape == img.shape, type(t).__name__
+
+    def test_random_erasing(self):
+        chw = np.ones((3, 16, 16), np.float32)
+        np.random.seed(1)
+        out = T.RandomErasing(prob=1.0, value=0.0)(chw)
+        assert out.shape == chw.shape
+        assert (out == 0).any() and (out == 1).any()
+        # functional erase on HWC
+        hwc = self._img()
+        er = T.erase(hwc, 2, 3, 4, 5, 0)
+        assert (er[2:6, 3:8] == 0).all()
+        assert np.array_equal(er[:2], hwc[:2])
+
+
+class TestReviewRegressions:
+    """Round-5 review findings pinned."""
+
+    def test_random_erasing_random_value_chw(self):
+        chw = np.ones((3, 16, 16), np.float32)
+        np.random.seed(2)
+        out = T.RandomErasing(prob=1.0, value="random")(chw)
+        assert out.shape == chw.shape
+        changed = out != 1
+        assert changed.any()
+        # per-channel noise fills along C, not smeared along width
+        assert not np.isnan(out).any()
+
+    def test_rotate_expand(self):
+        sq = (np.random.RandomState(3).rand(17, 17, 3) * 255).astype(np.uint8)
+        r = T.rotate(sq, 90, expand=True)
+        assert r.shape == sq.shape
+        assert np.abs(r.astype(int) - np.rot90(sq, 1).astype(int)).max() <= 1
+        rect = (np.random.RandomState(4).rand(10, 20, 3) * 255).astype(np.uint8)
+        r = T.rotate(rect, 90, expand=True)
+        assert r.shape[:2] == (20, 10)
+        assert np.abs(r.astype(int)
+                      - np.rot90(rect, 1).astype(int)).max() <= 1
+        # 45 deg expands the canvas to cover all corners
+        r45 = T.rotate(rect, 45, expand=True)
+        assert r45.shape[0] > 10 and r45.shape[1] > 10
+
+    def test_rotate_nearest_interpolation(self):
+        sq = (np.random.RandomState(5).rand(9, 9, 3) * 255).astype(np.uint8)
+        out = T.rotate(sq, 90, interpolation="nearest")
+        # nearest on a multiple-of-90 rotation is exact
+        assert np.array_equal(out, np.rot90(sq, 1))
+
+    def test_contrast_transform_matches_functional(self):
+        img = (np.random.RandomState(6).rand(8, 8, 3) * 255).astype(np.uint8)
+        np.random.seed(3)
+        f = 1 + np.random.uniform(-0.4, 0.4)
+        np.random.seed(3)
+        out = T.ContrastTransform(0.4)(img)
+        assert np.array_equal(out, T.adjust_contrast(img, f))
+
+    def test_reduce_lr_single_step_per_epoch(self):
+        """Monitored key in BOTH epoch and eval logs must count once."""
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        m = Model(net)
+        optimizer = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+        m.prepare(optimizer, nn.MSELoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+        cb.set_model(m)
+        for epoch in range(3):
+            cb.on_epoch_end(epoch, {"loss": 1.0})
+            cb.on_eval_end({"loss": 1.0})  # same epoch: must not double-count
+        # epochs 1 and 2 plateau -> exactly one reduction at epoch 2
+        assert optimizer.get_lr() == pytest.approx(0.05)
+
+    def test_reduce_lr_scheduler_scales_base(self):
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+        from paddle_tpu.optimizer.lr import ExponentialDecay
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        m = Model(net)
+        sched = ExponentialDecay(learning_rate=0.1, gamma=0.9)
+        optimizer = opt.SGD(learning_rate=sched, parameters=net.parameters())
+        m.prepare(optimizer, nn.MSELoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0,
+                               verbose=0)
+        cb.set_model(m)
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})
+        # base lr halved once; schedule multiplier NOT applied twice
+        assert sched.base_lr == pytest.approx(0.05)

@@ -112,3 +112,76 @@ def test_step_info_and_benchmark():
     b.end()
     assert "ips" in b.step_info()
     assert b.ips > 0
+
+
+def test_summary_overview_and_tables():
+    """Overview Summary (per-category totals) + per-op table with calls,
+    total/avg/min/max and ratio (reference profiler_statistic.py)."""
+    import paddle_tpu.profiler as profiler
+
+    p = profiler.Profiler(scheduler=(0, 1))
+    p.start()
+    with profiler.RecordEvent("userstep"):
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        for _ in range(3):
+            a = paddle.matmul(a, a)
+    p.stop()
+    s = p.summary()
+    assert "Overview Summary" in s
+    assert "Category: operator" in s
+    # matmul row: 3 calls
+    row = [ln for ln in s.splitlines() if ln.startswith("matmul")]
+    assert row and "3" in row[0].split()[1], row
+    assert "%" in row[0]
+
+
+def test_device_kernel_summary_from_trace(tmp_path):
+    """Kernel Summary parses device tracks out of a chrome trace (the
+    jax.profiler capture analog of the reference's CUPTI kernel records)."""
+    import gzip
+    import json
+
+    from paddle_tpu.profiler.statistic import (build_device_summary,
+                                               parse_device_trace)
+
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "python host"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1",
+             "ts": 0, "dur": 500.0},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1",
+             "ts": 600, "dur": 700.0},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "copy.2",
+             "ts": 1400, "dur": 100.0},
+            # host event must NOT appear in the kernel table
+            {"ph": "X", "pid": 9, "tid": 1, "name": "hostop",
+             "ts": 0, "dur": 9999.0},
+        ]
+    }
+    d = tmp_path / "plugins" / "profile" / "2026"
+    d.mkdir(parents=True)
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump(trace, f)
+
+    agg = parse_device_trace(str(d / "host.trace.json.gz"))
+    assert agg["fusion.1"]["calls"] == 2
+    assert agg["fusion.1"]["total"] == 1200.0 * 1e3  # us -> ns
+    assert "hostop" not in agg
+
+    lines = build_device_summary(str(tmp_path), time_unit="us")
+    text = "\n".join(lines)
+    assert "Kernel Summary" in text
+    assert "fusion.1" in text and "hostop" not in text
+    # top row is the biggest total and carries its ratio of device time
+    assert "92.3%" in text  # 1200/1300
+
+    # summary() composes it when device_trace_dir is set
+    import paddle_tpu.profiler as profiler
+
+    p = profiler.Profiler(scheduler=(0, 1), device_trace_dir=str(tmp_path))
+    p._events = []
+    s = p.summary()
+    assert "Kernel Summary" in s

@@ -1,0 +1,113 @@
+"""Attention kernel A/B on the current backend (meant for TPU).
+
+Compares, at the GPT-125M training shape (and optional others):
+  - this repo's Pallas flash kernel at several (bq, bk) block sizes
+  - jax.experimental.pallas.ops.tpu.flash_attention (the JAX team's tuned
+    TPU kernel) as the achievable-performance oracle
+  - the XLA composite (_ref_attention)
+
+Timing: device-side lax.scan loops (see tools/perf_breakdown.py) so the
+axon tunnel's per-dispatch overhead divides out.
+
+Usage: python tools/attn_ab.py [B] [S] [H] [D]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ITERS = 20
+
+
+def _host_sync(out):
+    leaf = jax.tree.leaves(out)[0]
+    one = leaf.ravel()[0:1] if getattr(leaf, "ndim", 0) else leaf
+    return np.asarray(jax.device_get(one))
+
+
+def timeit_scan(op, init, iters=ITERS):
+    f = jax.jit(lambda c: jax.lax.scan(lambda c, _: (op(c), None), c, None,
+                                       length=iters)[0])
+    _host_sync(f(init))
+    t0 = time.perf_counter()
+    _host_sync(f(init))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    argv = sys.argv[1:]
+    B = int(argv[0]) if len(argv) > 0 else 8
+    S = int(argv[1]) if len(argv) > 1 else 2048
+    H = int(argv[2]) if len(argv) > 2 else 12
+    D = int(argv[3]) if len(argv) > 3 else 64
+    key = jax.random.PRNGKey(0)
+    scale = 1.0 / (D ** 0.5)
+    flops_fwd = 2 * 2 * B * H * S * S * D / 2  # causal
+    q = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)  # [B,H,S,D]
+
+    print(json.dumps({"probe": "shape", "B": B, "S": S, "H": H, "D": D,
+                      "backend": jax.default_backend()}), flush=True)
+
+    def emit(name, dt, mult=1.0):
+        print(json.dumps({
+            "probe": name, "ms": round(dt * 1e3, 3),
+            "tflops": round(flops_fwd * mult / dt / 1e12, 1),
+        }), flush=True)
+
+    # ---- ours at several block sizes ----
+    from paddle_tpu.ops.pallas.flash_attention import _flash
+
+    for blk in ((512, 512), (256, 512), (512, 1024), (256, 256),
+                (128, 512), (128, 128), (1024, 1024)):
+        bq, bk = blk
+        if bq > S or bk > S:
+            continue
+        try:
+            fwd = lambda c: _flash(c, c, c, True, scale, bq, bk)
+            dt = timeit_scan(fwd, q)
+            emit(f"ours_fwd_{bq}x{bk}", dt)
+            g = jax.grad(lambda c: _flash(c, c, c, True, scale, bq, bk)
+                         .astype(jnp.float32).sum())
+            dt = timeit_scan(g, q)
+            emit(f"ours_fwdbwd_{bq}x{bk}", dt, 3.5)
+        except Exception as e:
+            print(json.dumps({"probe": f"ours_{bq}x{bk}",
+                              "error": f"{type(e).__name__}: {e}"[:160]}),
+                  flush=True)
+
+    # ---- jax reference TPU kernel (oracle) ----
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash)
+
+        fwd = lambda c: jax_flash(c, c, c, causal=True, sm_scale=scale)
+        dt = timeit_scan(fwd, q)
+        emit("jaxref_fwd_default", dt)
+        g = jax.grad(lambda c: jax_flash(c, c, c, causal=True, sm_scale=scale)
+                     .astype(jnp.float32).sum())
+        dt = timeit_scan(g, q)
+        emit("jaxref_fwdbwd_default", dt, 3.5)
+    except Exception as e:
+        print(json.dumps({"probe": "jaxref",
+                          "error": f"{type(e).__name__}: {e}"[:200]}),
+              flush=True)
+
+    # ---- XLA composite ----
+    from paddle_tpu.nn.functional.flash_attention import _ref_attention
+
+    comp = lambda c: jnp.swapaxes(
+        _ref_attention(jnp.swapaxes(c, 1, 2), jnp.swapaxes(c, 1, 2),
+                       jnp.swapaxes(c, 1, 2), causal=True), 1, 2)
+    dt = timeit_scan(comp, q)
+    emit("xla_fwd", dt)
+
+
+if __name__ == "__main__":
+    main()
