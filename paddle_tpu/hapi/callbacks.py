@@ -206,6 +206,7 @@ class ReduceLROnPlateau(Callback):
         self.cooldown_counter = 0
         self.epoch = 0
         self._last_epoch_stepped = None
+        self._pending = None
 
     def _improved(self, cur):
         if self.best is None:
@@ -260,11 +261,30 @@ class ReduceLROnPlateau(Callback):
             self.cooldown_counter = self.cooldown
             self.wait = 0
 
+    def on_epoch_begin(self, epoch, logs=None):
+        # no eval followed the previous epoch: its train observation counts
+        self._flush_pending()
+
     def on_epoch_end(self, epoch, logs=None):
+        # DEFER the train-log observation: fit() fires on_eval_end after
+        # on_epoch_end, and eval metrics must win over same-named train
+        # metrics (reference semantics reduce on the eval metric)
         self.epoch = epoch
-        self._step(logs)
+        self._pending = (epoch, dict(logs or {}))
 
     def on_eval_end(self, logs=None):
+        self._pending = None
+        self._step(logs)
+
+    def on_train_end(self, logs=None):
+        self._flush_pending()
+
+    def _flush_pending(self):
+        if self._pending is None:
+            return
+        epoch, logs = self._pending
+        self._pending = None
+        self.epoch = epoch
         self._step(logs)
 
 

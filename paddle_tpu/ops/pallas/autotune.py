@@ -94,9 +94,11 @@ def _candidates(sq, skv, default):
     """Legal (bq, bk) choices: block divides (or covers) the padded seq,
     bounded so the f32 logits tile [bq, bk] stays well under VMEM."""
     cands = {default}
-    for bq in (128, 256, 512):
-        for bk in (128, 256, 512):
-            if bq * bk > 512 * 512:
+    for bq in (128, 256, 512, 1024):
+        for bk in (128, 256, 512, 1024):
+            if bq * bk > 1024 * 1024:
+                # f32 logits tile caps at 4MB — round-5 on-chip sweeps show
+                # the large tiles (512x1024, 1024x1024) winning at long seq
                 continue
             if sq >= bq and skv >= bk:
                 cands.add((bq, bk))

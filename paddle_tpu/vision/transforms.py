@@ -232,19 +232,29 @@ def _is_chw(img):
 
 
 def _clip_like(out, ref):
+    """Warp resampling: preserve the image's own range (normalized float
+    images legitimately hold negative values)."""
     if ref.dtype == np.uint8:
         return np.clip(np.round(out), 0, 255.0).astype(np.uint8)
     return out.astype(ref.dtype)
 
 
+def _clip_color(out, ref):
+    """Color adjustments: intensities stay non-negative for floats as well
+    (matches the pre-round-5 Brightness/ContrastTransform clipping)."""
+    if ref.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255.0).astype(np.uint8)
+    return np.clip(out, 0, None).astype(ref.dtype)
+
+
 def adjust_brightness(img, factor):
-    return _clip_like(img.astype(np.float32) * factor, img)
+    return _clip_color(img.astype(np.float32) * factor, img)
 
 
 def adjust_contrast(img, factor):
     f = img.astype(np.float32)
     mean = to_grayscale(img).astype(np.float32).mean()
-    return _clip_like((f - mean) * factor + mean, img)
+    return _clip_color((f - mean) * factor + mean, img)
 
 
 def to_grayscale(img, num_output_channels=1):
@@ -263,13 +273,15 @@ def to_grayscale(img, num_output_channels=1):
 def adjust_saturation(img, factor):
     f = img.astype(np.float32)
     gray = to_grayscale(img, 3).astype(np.float32)
-    return _clip_like(gray + (f - gray) * factor, img)
+    return _clip_color(gray + (f - gray) * factor, img)
 
 
 def adjust_hue(img, hue_factor):
     """Shift hue by hue_factor (in [-0.5, 0.5] turns) through HSV."""
     if not -0.5 <= hue_factor <= 0.5:
         raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    if img.ndim == 2 or img.shape[-1] == 1:
+        return img  # hue is undefined on grayscale (torchvision behavior)
     f = img.astype(np.float32) / (255.0 if img.dtype == np.uint8 else 1.0)
     r, g, b = f[..., 0], f[..., 1], f[..., 2]
     mx, mn = f[..., :3].max(-1), f[..., :3].min(-1)
@@ -290,7 +302,7 @@ def adjust_hue(img, hue_factor):
     out = np.stack([r2, g2, b2], -1)
     if img.dtype == np.uint8:
         out = out * 255.0
-    return _clip_like(out, img)
+    return _clip_color(out, img)
 
 
 def _warp(img, inv33, fill=0.0, perspective=False, method="bilinear",
@@ -414,7 +426,8 @@ def perspective(img, startpoints, endpoints, interpolation="bilinear", fill=0):
     """Warp so that startpoints map to endpoints (reference
     functional.perspective)."""
     fwd = _homography(startpoints, endpoints)
-    return _warp(img, np.linalg.inv(fwd), fill=fill, perspective=True)
+    return _warp(img, np.linalg.inv(fwd), fill=fill, perspective=True,
+                 method=interpolation)
 
 
 def erase(img, i, j, h, w, v, inplace=False):
@@ -545,6 +558,7 @@ class RandomPerspective(BaseTransform):
         self.prob = prob
         self.distortion_scale = distortion_scale
         self.fill = fill
+        self.interpolation = interpolation
 
     def _apply_image(self, img):
         if np.random.uniform() >= self.prob:
@@ -560,7 +574,8 @@ class RandomPerspective(BaseTransform):
                 H - 1 - np.random.randint(0, dy + 1)),
                (np.random.randint(0, dx + 1),
                 H - 1 - np.random.randint(0, dy + 1))]
-        return perspective(img, start, end, fill=self.fill)
+        return perspective(img, start, end, fill=self.fill,
+                           interpolation=self.interpolation)
 
 
 class RandomErasing(BaseTransform):

@@ -445,3 +445,44 @@ class TestHybridParallelOptimizer:
         hpo.step()
         np.testing.assert_allclose(shared.numpy(), -1.0)
         np.testing.assert_allclose(other.numpy(), -1.0)
+
+
+class TestStoreKeyCleanup:
+    """ADVICE round-3: group-communicator store keys must not leak for the
+    job's life — destroy_process_group sweeps this rank's residual gar/
+    keys (eager_multiproc.cleanup_group_keys)."""
+
+    def test_rolling_and_destroy_cleanup(self, monkeypatch):
+        from paddle_tpu.distributed import eager_multiproc as mp
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore(is_master=True, port=0)
+        try:
+            monkeypatch.setattr(mp, "rank", lambda: 0)
+            monkeypatch.setattr(mp, "nprocs", lambda: 2)
+            mp._group_seq.clear()
+            for _ in range(4):
+                out = mp.store_allreduce_group(
+                    store, np.array([2.0]), [0], gid=7)
+                assert float(out[0]) == 2.0
+            tag = "0#g7"
+            live = [s for s in range(4)
+                    if store.tryget(f"gar/{tag}/{s}/0") is not None]
+            # rolling cleanup keeps only the last two rounds
+            assert live == [2, 3], live
+
+            # destroy_process_group sweeps the rest
+            import paddle_tpu.distributed as dist
+            from paddle_tpu.distributed import store as store_mod
+
+            monkeypatch.setattr(store_mod,
+                                "create_or_get_global_tcp_store",
+                                lambda *a, **k: store)
+            dist.destroy_process_group()
+            live = [s for s in range(4)
+                    if store.tryget(f"gar/{tag}/{s}/0") is not None]
+            assert live == [], live
+            assert tag not in mp._group_seq
+        finally:
+            mp._group_seq.clear()
+            store.close()

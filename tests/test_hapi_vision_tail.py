@@ -41,13 +41,18 @@ class TestCallbacksTail:
                                verbose=0)
         cb.set_model(m)
         # flat loss: first epoch sets best; each later epoch waits, reduce
-        # fires when wait hits patience
-        cb.on_epoch_end(0, {"loss": 1.0})
-        cb.on_epoch_end(1, {"loss": 1.0})
+        # fires when wait hits patience. Train-log observations are deferred
+        # to the next epoch boundary (eval logs would take precedence).
+        for e, loss in [(0, 1.0), (1, 1.0)]:
+            cb.on_epoch_begin(e)
+            cb.on_epoch_end(e, {"loss": loss})
+        cb.on_epoch_begin(2)
         assert optimizer.get_lr() == pytest.approx(0.05)
         # improvement resets the wait counter
         cb.on_epoch_end(2, {"loss": 0.5})
+        cb.on_epoch_begin(3)
         cb.on_epoch_end(3, {"loss": 0.5})
+        cb.on_train_end()
         assert optimizer.get_lr() == pytest.approx(0.025)
 
     def test_reduce_lr_respects_min_lr(self):
@@ -55,9 +60,10 @@ class TestCallbacksTail:
         cb = ReduceLROnPlateau(monitor="loss", factor=0.1, patience=0,
                                min_lr=0.05, verbose=0)
         cb.set_model(m)
-        cb.on_epoch_end(0, {"loss": 1.0})
-        cb.on_epoch_end(1, {"loss": 1.0})
-        cb.on_epoch_end(2, {"loss": 1.0})
+        for e in range(3):
+            cb.on_epoch_begin(e)
+            cb.on_epoch_end(e, {"loss": 1.0})
+        cb.on_train_end()
         assert optimizer.get_lr() == pytest.approx(0.05)
 
     def test_visualdl_writes_scalars(self, tmp_path):
@@ -211,9 +217,12 @@ class TestReviewRegressions:
                                verbose=0)
         cb.set_model(m)
         for epoch in range(3):
-            cb.on_epoch_end(epoch, {"loss": 1.0})
-            cb.on_eval_end({"loss": 1.0})  # same epoch: must not double-count
-        # epochs 1 and 2 plateau -> exactly one reduction at epoch 2
+            cb.on_epoch_begin(epoch)
+            # TRAIN loss improves every epoch; EVAL loss is flat — the
+            # plateau must be tracked on the EVAL metric (reference
+            # semantics), so the lr still reduces
+            cb.on_epoch_end(epoch, {"loss": 1.0 / (epoch + 1)})
+            cb.on_eval_end({"loss": 1.0})
         assert optimizer.get_lr() == pytest.approx(0.05)
 
     def test_reduce_lr_scheduler_scales_base(self):
@@ -231,7 +240,31 @@ class TestReviewRegressions:
         cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0,
                                verbose=0)
         cb.set_model(m)
-        cb.on_epoch_end(0, {"loss": 1.0})
-        cb.on_epoch_end(1, {"loss": 1.0})
+        for e in range(2):
+            cb.on_epoch_begin(e)
+            cb.on_epoch_end(e, {"loss": 1.0})
+        cb.on_train_end()
         # base lr halved once; schedule multiplier NOT applied twice
         assert sched.base_lr == pytest.approx(0.05)
+
+    def test_hue_grayscale_passthrough(self):
+        g = (np.random.RandomState(8).rand(8, 8) * 255).astype(np.uint8)
+        assert np.array_equal(T.adjust_hue(g, 0.3), g)
+        assert np.array_equal(T.adjust_hue(g[..., None], 0.3), g[..., None])
+
+    def test_float_color_ops_stay_nonnegative(self):
+        img = np.random.RandomState(9).rand(8, 8, 3).astype(np.float32)
+        out = T.adjust_contrast(img, 3.0)
+        assert (out >= 0).all()
+        out = T.adjust_brightness(img, 0.5)
+        assert (out >= 0).all()
+        # warps, by contrast, must NOT clip normalized (negative) values
+        norm = img - 0.5
+        w = T.affine(norm, translate=(1, 0))
+        assert (w < 0).any()
+
+    def test_perspective_nearest_preserves_label_values(self):
+        mask = np.random.RandomState(10).randint(0, 5, (12, 12, 1)).astype(np.float32)
+        np.random.seed(4)
+        out = T.RandomPerspective(prob=1.0, interpolation="nearest")(mask)
+        assert set(np.unique(out)).issubset(set(np.unique(mask)) | {0.0})
