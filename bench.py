@@ -76,9 +76,20 @@ def _probe_backend(max_tries=2, timeout_s=180.0):
     return None, err
 
 
-def _timed_steps(step_fn, steps, trace_dir=None):
+def _timed_steps(step_fn, steps, trace_dir=None, warmup=3):
     """Warmed-up timed loop; returns seconds/step. step_fn() must return a
-    device value whose float() forces completion."""
+    device value whose float() forces completion.
+
+    warmup: executions AFTER compile before the clock starts — the first few
+    runs of a fresh executable through the axon tunnel pay settling costs
+    (measured round 5: ~2x on the first timed batch), which inflated the
+    125M rung from 192 to 272 ms/step when only one warmup call ran."""
+    # warmup BEFORE the profiler starts so the trace holds only timed steps
+    last = None
+    for _ in range(warmup):
+        last = step_fn()
+    if last is not None:
+        _ = float(last)
     prof = None
     if trace_dir:
         import paddle_tpu.profiler as profiler

@@ -529,7 +529,13 @@ def _tuned_blocks(q, k, v, causal, scale):
     def run_with(bq, bk):
         out, _ = _fwd(_pad_seq(q, bq), _pad_seq(k, bk), _pad_seq(v, bk),
                       scale, causal, sq, skv, bq=bq, bk=bk)
-        jax.block_until_ready(out)
+        # REAL device->host fetch: through the axon tunnel,
+        # block_until_ready returns before execution finishes, which made
+        # every candidate measure the same dispatch latency and the tuner
+        # pick effectively at random (round-5 bench regression)
+        import numpy as _np
+
+        _np.asarray(jax.device_get(out.ravel()[0:1]))
 
     concrete = not any(isinstance(x, jax.core.Tracer) for x in (q, k, v))
     B, H, _, D = q.shape

@@ -267,12 +267,20 @@ def main():
         per_layer_ms = (2.0 * proj_bwd["ms"] + mlp_bwd_line["ms"]
                         + ab[attn_key]["ms"])
         comp_ms = L * per_layer_ms + head_bwd_line["ms"]
-        print(json.dumps({
+        cov = comp_ms / (dt_step * 1e3)
+        line = {
             "probe": "components_sum",
             "layers_x_perlayer_plus_head_ms": round(comp_ms, 1),
             "train_step_ms": round(dt_step * 1e3, 1),
-            "coverage": round(comp_ms / (dt_step * 1e3), 3),
-        }), flush=True)
+            "coverage": round(cov, 3),
+        }
+        # isolated probes cannot overlap with neighbors the way the fused
+        # step does, so coverage > 1 is expected; far outside [0.7, 1.3]
+        # means the attribution is not trustworthy for ranking components
+        if not 0.7 <= cov <= 1.3:
+            line["note"] = ("coverage outside [0.7, 1.3]: isolated-probe "
+                            "attribution unreliable for this run")
+        print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":
