@@ -93,6 +93,47 @@ def _reduce(v, reduction):
     return v
 
 
+def _sparse_ce_impl(logits, safe_ids):
+    """Shared primal math for _sparse_ce and its VJP fwd: (loss, lse)."""
+    lf = logits.astype(jnp.float32)
+    m = jnp.max(lf, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(lf - m), axis=-1)) + m[..., 0]
+    tgt = jnp.take_along_axis(lf, safe_ids[..., None], axis=-1)[..., 0]
+    return lse - tgt, lse
+
+
+@jax.custom_vjp
+def _sparse_ce(logits, safe_ids):
+    """Memory-lean sparse softmax-CE: lse - target_logit per row.
+
+    The straight `log_softmax + gather` formulation makes AD save the full
+    f32 log-probs tensor as a residual — 3.3GB for the GPT-3 125M bench
+    shape [8, 2048, 50k], a pure HBM tax (round-5 breakdown: the lm-head+CE
+    block ran at half the step's efficiency). This custom VJP saves only
+    (logits, lse) and reconstructs softmax in the backward. Reference
+    analog: c_softmax_with_cross_entropy / fused CE kernels."""
+    return _sparse_ce_impl(logits, safe_ids)[0]
+
+
+def _sparse_ce_fwd(logits, safe_ids):
+    loss, lse = _sparse_ce_impl(logits, safe_ids)
+    return loss, (logits, safe_ids, lse)
+
+
+def _sparse_ce_bwd(res, g):
+    logits, safe_ids, lse = res
+    lf = logits.astype(jnp.float32)
+    p = jnp.exp(lf - lse[..., None])  # softmax, recomputed not stored
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, lf.shape, lf.ndim - 1)
+              == safe_ids[..., None])
+    dl = (p - onehot.astype(jnp.float32)) * g[..., None]
+    return (dl.astype(logits.dtype),
+            np.zeros(safe_ids.shape, jax.dtypes.float0))
+
+
+_sparse_ce.defvjp(_sparse_ce_fwd, _sparse_ce_bwd)
+
+
 def cross_entropy(
     input,  # noqa: A002
     label,
@@ -115,11 +156,14 @@ def cross_entropy(
         ins.append(_t(weight))
 
     def fn(logits, lab, *rest):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
-        else:
-            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30, None))
+        def _logp():
+            if use_softmax:
+                return jax.nn.log_softmax(logits.astype(jnp.float32),
+                                          axis=axis)
+            return jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30, None))
+
         if soft_label:
+            logp = _logp()
             tgt = lab.astype(jnp.float32)
             if label_smoothing > 0:
                 k = logits.shape[axis]
@@ -135,6 +179,17 @@ def cross_entropy(
             ids = jnp.squeeze(ids, axis)
         valid = ids != ignore_index
         safe = jnp.where(valid, ids, 0)
+        if (use_softmax and not has_w and label_smoothing == 0
+                and axis in (-1, logits.ndim - 1)):
+            # hot path (LLM pretraining loss): custom-VJP CE that never
+            # materializes the f32 log-probs tensor (see _sparse_ce)
+            loss = jnp.where(valid, _sparse_ce(logits, safe), 0.0)
+            if reduction == "mean":
+                n_valid = jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                      1.0)
+                return jnp.sum(loss) / n_valid
+            return _reduce(loss, reduction)
+        logp = _logp()
         picked = jnp.take_along_axis(
             logp, jnp.expand_dims(safe, axis), axis=axis
         ).squeeze(axis)
