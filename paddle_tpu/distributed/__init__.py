@@ -12,7 +12,7 @@ Architecture (SURVEY §5.8 TPU-native mapping):
 - launch/: multi-host process launcher
 """
 
-from . import collective, env, fleet, parallel, sharding
+from . import collective, env, fleet, parallel, rpc, sharding
 from .collective import (
     P2POp,
     ReduceOp,

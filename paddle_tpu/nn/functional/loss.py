@@ -96,8 +96,7 @@ def _reduce(v, reduction):
 def _sparse_ce_impl(logits, safe_ids):
     """Shared primal math for _sparse_ce and its VJP fwd: (loss, lse)."""
     lf = logits.astype(jnp.float32)
-    m = jnp.max(lf, axis=-1, keepdims=True)
-    lse = jnp.log(jnp.sum(jnp.exp(lf - m), axis=-1)) + m[..., 0]
+    lse = jax.nn.logsumexp(lf, axis=-1)
     tgt = jnp.take_along_axis(lf, safe_ids[..., None], axis=-1)[..., 0]
     return lse - tgt, lse
 
