@@ -1,0 +1,298 @@
+// C inference API over the paddle_tpu Predictor (reference analog:
+// paddle/fluid/inference/capi_exp/pd_inference_api.h, the
+// paddle_inference_c library that C/Go deployments link against).
+//
+// TPU-native design: the inference runtime IS the Python-side
+// TranslatedLayer playing a compiled XLA executable; this shim embeds (or
+// attaches to) CPython and drives paddle_tpu.inference through the C ABI.
+// - Standalone C/Go program: the first call initializes an interpreter.
+// - Inside an existing Python process (ctypes tests, plugins): attaches to
+//   the running interpreter via PyGILState.
+// Data moves through the buffer protocol (no numpy C headers needed).
+//
+// Build: make -C native libpaddle_tpu_c.so
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+typedef int32_t PD_Bool;
+
+struct PD_Config {
+  std::string prog_file;
+};
+
+struct PD_Predictor {
+  PyObject* pred;  // paddle_tpu.inference.Predictor
+};
+
+struct PD_Tensor {
+  PyObject* handle;  // paddle_tpu.inference._Handle
+  std::vector<int32_t> shape;
+  std::string dtype;  // "float32" | "int32" | "int64"
+};
+
+namespace {
+
+// ensure an interpreter exists and PYTHONPATH covers the repo; returns a
+// held GIL state. Every exported function brackets with Gil g;
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // embedding case: release the main thread's GIL so PyGILState works
+      (void)PyEval_SaveThread();
+    }
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* inference_module() {
+  PyObject* m = PyImport_ImportModule("paddle_tpu.inference");
+  if (!m) PyErr_Print();
+  return m;
+}
+
+// contiguous numpy array of `dtype` with `shape`; borrowed refs managed by
+// caller
+PyObject* np_empty(const std::vector<int32_t>& shape, const char* dtype) {
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) return nullptr;
+  PyObject* dims = PyTuple_New((Py_ssize_t)shape.size());
+  for (size_t i = 0; i < shape.size(); ++i)
+    PyTuple_SET_ITEM(dims, (Py_ssize_t)i, PyLong_FromLong(shape[i]));
+  PyObject* arr = PyObject_CallMethod(np, "empty", "Os", dims, dtype);
+  Py_DECREF(dims);
+  Py_DECREF(np);
+  return arr;
+}
+
+size_t numel(const std::vector<int32_t>& shape) {
+  size_t n = 1;
+  for (int32_t d : shape) n *= (size_t)d;
+  return n;
+}
+
+void copy_from_cpu(PD_Tensor* t, const void* data, const char* dtype,
+                   size_t elem) {
+  Gil g;
+  t->dtype = dtype;
+  PyObject* arr = np_empty(t->shape, dtype);
+  if (!arr) { PyErr_Print(); return; }
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG) == 0) {
+    std::memcpy(view.buf, data, numel(t->shape) * elem);
+    PyBuffer_Release(&view);
+    PyObject* r = PyObject_CallMethod(t->handle, "copy_from_cpu", "O", arr);
+    if (!r) PyErr_Print();
+    Py_XDECREF(r);
+  }
+  Py_DECREF(arr);
+}
+
+void copy_to_cpu(PD_Tensor* t, void* data, size_t elem) {
+  Gil g;
+  PyObject* arr = PyObject_CallMethod(t->handle, "copy_to_cpu", nullptr);
+  if (!arr) { PyErr_Print(); return; }
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* c = PyObject_CallMethod(np, "ascontiguousarray", "O", arr);
+  Py_DECREF(np);
+  Py_DECREF(arr);
+  if (!c) { PyErr_Print(); return; }
+  Py_buffer view;
+  if (PyObject_GetBuffer(c, &view, PyBUF_CONTIG_RO) == 0) {
+    std::memcpy(data, view.buf, (size_t)view.len);
+    PyBuffer_Release(&view);
+  }
+  Py_DECREF(c);
+}
+
+void refresh_shape(PD_Tensor* t) {
+  // shape of the handle's current array (valid after a run)
+  PyObject* arr = PyObject_CallMethod(t->handle, "copy_to_cpu", nullptr);
+  if (!arr) { PyErr_Clear(); return; }
+  PyObject* shp = PyObject_GetAttrString(arr, "shape");
+  if (shp) {
+    t->shape.clear();
+    Py_ssize_t n = PyTuple_Size(shp);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      t->shape.push_back(
+          (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(shp, i)));
+    Py_DECREF(shp);
+  }
+  Py_DECREF(arr);
+}
+
+std::string nth_name(PD_Predictor* p, const char* method, int i) {
+  PyObject* names = PyObject_CallMethod(p->pred, method, nullptr);
+  if (!names) { PyErr_Print(); return ""; }
+  std::string out;
+  PyObject* item = PySequence_GetItem(names, i);
+  if (item) {
+    out = PyUnicode_AsUTF8(item);
+    Py_DECREF(item);
+  }
+  Py_DECREF(names);
+  return out;
+}
+
+int name_count(PD_Predictor* p, const char* method) {
+  Gil g;
+  PyObject* names = PyObject_CallMethod(p->pred, method, nullptr);
+  if (!names) { PyErr_Print(); return 0; }
+  int n = (int)PySequence_Size(names);
+  Py_DECREF(names);
+  return n;
+}
+
+PD_Tensor* get_handle(PD_Predictor* p, const char* method, const char* name) {
+  Gil g;
+  PyObject* h = PyObject_CallMethod(p->pred, method, "s", name);
+  if (!h) { PyErr_Print(); return nullptr; }
+  PD_Tensor* t = new PD_Tensor();
+  t->handle = h;
+  return t;
+}
+
+thread_local std::string g_name_buf;
+
+}  // namespace
+
+// ---------------------------------------------------------------------- //
+// config
+// ---------------------------------------------------------------------- //
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
+                       const char* params_file) {
+  (void)params_file;  // single-artifact format: weights ride the program
+  c->prog_file = prog_file ? prog_file : "";
+}
+
+void PD_ConfigSetProgFile(PD_Config* c, const char* prog_file) {
+  c->prog_file = prog_file ? prog_file : "";
+}
+
+// ---------------------------------------------------------------------- //
+// predictor
+// ---------------------------------------------------------------------- //
+
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  Gil g;
+  PyObject* m = inference_module();
+  if (!m) return nullptr;
+  PyObject* cfg =
+      PyObject_CallMethod(m, "Config", "s", c->prog_file.c_str());
+  PyObject* pred =
+      cfg ? PyObject_CallMethod(m, "create_predictor", "O", cfg) : nullptr;
+  Py_XDECREF(cfg);
+  Py_DECREF(m);
+  if (!pred) { PyErr_Print(); return nullptr; }
+  PD_Predictor* p = new PD_Predictor();
+  p->pred = pred;
+  return p;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  Gil g;
+  Py_XDECREF(p->pred);
+  delete p;
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p) {
+  return (size_t)name_count(p, "get_input_names");
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor* p) {
+  return (size_t)name_count(p, "get_output_names");
+}
+
+const char* PD_PredictorGetInputNameByIndex(PD_Predictor* p, int i) {
+  Gil g;
+  g_name_buf = nth_name(p, "get_input_names", i);
+  return g_name_buf.c_str();
+}
+
+const char* PD_PredictorGetOutputNameByIndex(PD_Predictor* p, int i) {
+  Gil g;
+  g_name_buf = nth_name(p, "get_output_names", i);
+  return g_name_buf.c_str();
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
+  return get_handle(p, "get_input_handle", name);
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
+  PD_Tensor* t = get_handle(p, "get_output_handle", name);
+  if (t) {
+    Gil g;
+    refresh_shape(t);
+  }
+  return t;
+}
+
+PD_Bool PD_PredictorRun(PD_Predictor* p) {
+  Gil g;
+  PyObject* r = PyObject_CallMethod(p->pred, "run", nullptr);
+  if (!r) { PyErr_Print(); return 0; }
+  Py_DECREF(r);
+  return 1;
+}
+
+// ---------------------------------------------------------------------- //
+// tensors
+// ---------------------------------------------------------------------- //
+
+void PD_TensorDestroy(PD_Tensor* t) {
+  if (!t) return;
+  Gil g;
+  Py_XDECREF(t->handle);
+  delete t;
+}
+
+void PD_TensorReshape(PD_Tensor* t, size_t ndim, const int32_t* shape) {
+  t->shape.assign(shape, shape + ndim);
+}
+
+size_t PD_TensorGetNumDims(PD_Tensor* t) { return t->shape.size(); }
+
+void PD_TensorGetShape(PD_Tensor* t, int32_t* out) {
+  std::memcpy(out, t->shape.data(), t->shape.size() * sizeof(int32_t));
+}
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data) {
+  copy_from_cpu(t, data, "float32", sizeof(float));
+}
+
+void PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data) {
+  copy_from_cpu(t, data, "int32", sizeof(int32_t));
+}
+
+void PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data) {
+  copy_from_cpu(t, data, "int64", sizeof(int64_t));
+}
+
+void PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data) {
+  copy_to_cpu(t, data, sizeof(float));
+}
+
+void PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data) {
+  copy_to_cpu(t, data, sizeof(int32_t));
+}
+
+void PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data) {
+  copy_to_cpu(t, data, sizeof(int64_t));
+}
+
+}  // extern "C"

@@ -91,6 +91,17 @@ class Engine:
         completion.py, cost_model): sharding PROPAGATION is GSPMD's job
         here, so the plan space is exactly the mesh factorization, and the
         cost model only has to rank factorizations."""
+        costs = self.candidate_costs(ndev, model_cfg)
+        if not costs:
+            raise RuntimeError(
+                "no feasible parallel plan within the memory cap")
+        return min(costs, key=costs.get)
+
+    def candidate_costs(self, ndev, model_cfg=None):
+        """Analytic per-step cost (arbitrary units) for every feasible
+        (dp, pp, sharding, mp) factorization — the cost model behind
+        plan(), exposed so its RANKING can be validated against measured
+        step times (tests/test_engine.py round-5 validation)."""
         from ..auto_tuner.tuner import _divisors, estimate_memory_bytes
 
         cfg = model_cfg or self._infer_model_cfg()
@@ -102,7 +113,7 @@ class Engine:
         tuner_cfg = {"model_cfg": cfg,
                      "max_mem_usage_bytes": cfg.get("max_mem_usage_bytes")}
 
-        best, best_cost = None, float("inf")
+        costs = {}
         for mp in _divisors(ndev):
             for pp in _divisors(ndev // mp):
                 for shard in _divisors(ndev // (mp * pp)):
@@ -133,13 +144,8 @@ class Engine:
                     if dp * shard > 1:
                         comm += n_params / (mp * pp) \
                             * (dp * shard - 1) / (dp * shard) * 4
-                    cost = flops * (1 + bubble) + comm
-                    if cost < best_cost:
-                        best, best_cost = (dp, pp, shard, mp), cost
-        if best is None:
-            raise RuntimeError(
-                "no feasible parallel plan within the memory cap")
-        return best
+                    costs[(dp, pp, shard, mp)] = flops * (1 + bubble) + comm
+        return costs
 
     def _infer_model_cfg(self):
         cfg = getattr(self._model, "config", None)

@@ -101,6 +101,9 @@ class _RpcServer:
     def _serve_one(self, conn):
         try:
             with conn:
+                # a peer that connects but never sends (crash, port scan)
+                # must not pin this worker thread forever and hang stop()
+                conn.settimeout(120)
                 fn, args, kwargs = pickle.loads(_recv_msg(conn))
                 try:
                     out = ("ok", fn(*args, **kwargs))
@@ -155,20 +158,34 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     master_addr, master_port = master_endpoint.rsplit(":", 1)
 
     server = _RpcServer()
-    store = TCPStore(master_addr, int(master_port), is_master=(rank == 0),
-                     world_size=world_size)
-    ip = os.environ.get("PADDLE_WORKER_IP") or _self_ip(master_addr)
-    me = WorkerInfo(name, rank, ip, server.port)
-    store.set(f"rpc/worker/{rank}", pickle.dumps(me))
+    store = None
+    try:
+        store = TCPStore(master_addr, int(master_port),
+                         is_master=(rank == 0), world_size=world_size)
+        ip = os.environ.get("PADDLE_WORKER_IP") or _self_ip(master_addr)
+        me = WorkerInfo(name, rank, ip, server.port)
+        store.set(f"rpc/worker/{rank}", pickle.dumps(me))
 
-    workers = {}
-    for r in range(world_size):
-        key = f"rpc/worker/{r}"
-        store.wait([key])
-        info = pickle.loads(store.get(key))
-        if info.name in workers:
-            raise RuntimeError(f"duplicate rpc worker name {info.name!r}")
-        workers[info.name] = info
+        workers = {}
+        for r in range(world_size):
+            key = f"rpc/worker/{r}"
+            store.wait([key])
+            info = pickle.loads(store.get(key))
+            if info.name in workers:
+                raise RuntimeError(
+                    f"duplicate rpc worker name {info.name!r}")
+            workers[info.name] = info
+    except BaseException:
+        # a failed rendezvous must not leak the started server (accept
+        # thread, pool, bound port) or the store connection — the caller
+        # may retry init_rpc
+        server.stop()
+        if store is not None:
+            try:
+                store.close()
+            except Exception:
+                pass
+        raise
 
     _state.update(store=store, server=server, self=me, workers=workers,
                   pool=ThreadPoolExecutor(max_workers=8,
@@ -229,6 +246,15 @@ def shutdown():
     # not find the peer's server already stopped after everyone passes it
     _state["pool"].shutdown(wait=True)
     _barrier("rpc/shutdown")
+    # ack round: the store host (rank 0) must not close the store while a
+    # slower rank's barrier WAIT request is still in flight — it waits for
+    # every rank's explicit ack, which each rank posts only after its own
+    # barrier wait returned
+    st, me = _state["store"], _state["self"]
+    n = len(_state["workers"])
+    st.set(f"rpc/shutdown_ack/{me.rank}", b"1")
+    if me.rank == 0:
+        st.wait([f"rpc/shutdown_ack/{r}" for r in range(n)])
     _state["server"].stop()
     try:
         _state["store"].close()
