@@ -15,6 +15,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -33,7 +34,6 @@ struct PD_Predictor {
 struct PD_Tensor {
   PyObject* handle;  // paddle_tpu.inference._Handle
   std::vector<int32_t> shape;
-  std::string dtype;  // "float32" | "int32" | "int64"
 };
 
 namespace {
@@ -43,11 +43,16 @@ namespace {
 struct Gil {
   PyGILState_STATE st;
   Gil() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // embedding case: release the main thread's GIL so PyGILState works
-      (void)PyEval_SaveThread();
-    }
+    // first-use interpreter init must be raced-safely: two threads of a
+    // C/Go host can hit the API concurrently at startup
+    static std::once_flag init_once;
+    std::call_once(init_once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        // embedding case: release the main thread's GIL so PyGILState works
+        (void)PyEval_SaveThread();
+      }
+    });
     st = PyGILState_Ensure();
   }
   ~Gil() { PyGILState_Release(st); }
@@ -82,7 +87,6 @@ size_t numel(const std::vector<int32_t>& shape) {
 void copy_from_cpu(PD_Tensor* t, const void* data, const char* dtype,
                    size_t elem) {
   Gil g;
-  t->dtype = dtype;
   PyObject* arr = np_empty(t->shape, dtype);
   if (!arr) { PyErr_Print(); return; }
   Py_buffer view;
@@ -135,8 +139,13 @@ std::string nth_name(PD_Predictor* p, const char* method, int i) {
   std::string out;
   PyObject* item = PySequence_GetItem(names, i);
   if (item) {
-    out = PyUnicode_AsUTF8(item);
+    const char* u = PyUnicode_AsUTF8(item);
+    if (u) out = u; else PyErr_Clear();
     Py_DECREF(item);
+  } else {
+    // out-of-range index: a pending IndexError must not leak into the
+    // host interpreter (attach path) or later C API calls
+    PyErr_Clear();
   }
   Py_DECREF(names);
   return out;

@@ -105,6 +105,10 @@ class _RpcServer:
                 # must not pin this worker thread forever and hang stop()
                 conn.settimeout(120)
                 fn, args, kwargs = pickle.loads(_recv_msg(conn))
+                # the RESPONSE send gets a far looser bound: settimeout is
+                # total-duration, and a large result over a slow link is
+                # legitimate — 120s there would abort it
+                conn.settimeout(900)
                 try:
                     out = ("ok", fn(*args, **kwargs))
                 except BaseException as e:  # noqa: BLE001 — ship to caller

@@ -74,7 +74,7 @@ def _flashmask_keep(idx_blk, row, col, sq, skv, causal, n):
     return keep & ~masked
 
 
-def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref,
+def _fm_fwd_kernel(q_ref, kt_ref, v_ref, idx_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr,
                    *, scale, causal, n, sq, skv, bq, bk, nk):
     i = pl.program_id(2)
@@ -98,10 +98,12 @@ def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref,
 
     @pl.when(needed & jnp.any(keep))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        # feed the MXU native dtypes (bf16 under AMP — an f32 upcast would
+        # cost ~4x MXU passes); accumulation is f32 via preferred_element_type
+        q = q_ref[0, 0]
+        kt = kt_ref[0, 0]  # [D, bk]: MXU-native QK^T (see flash_attention.py)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, kt, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         s = jnp.where(keep, s, NEG_INF)
 
@@ -113,9 +115,10 @@ def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref,
         p = jnp.where(keep, p, 0.0)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -132,8 +135,8 @@ def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref,
                                   m_scr[:, :1] + jnp.log(l_safe))
 
 
-def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dq_scr,
+def _fm_bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, idx_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dq_scr,
                       *, scale, causal, n, sq, skv, bq, bk, nk):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -152,20 +155,21 @@ def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed & jnp.any(keep))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]  # [bq, 1]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, kt_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         ) * scale
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, vt_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -175,8 +179,8 @@ def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_scr, dv_scr,
+def _fm_bwd_dkv_kernel(q_ref, kt_ref, vt_ref, idx_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                        *, scale, causal, n, sq, skv, bq, bk, nq):
     j = pl.program_id(2)  # kv block
     i = pl.program_id(3)  # q block
@@ -196,23 +200,24 @@ def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed & jnp.any(keep))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]  # [bq, 1]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, kt_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         ) * scale
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, vt_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -224,11 +229,13 @@ def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
 
 
 def _fm_specs(B, H, Hm, Hkv, n, bq, bk, D):
+    """[q, kT, v, idx] input specs (K rides TRANSPOSED [B,Hkv,D,S] so the
+    QK^T contraction is MXU-native — see flash_attention.py)."""
     group = H // Hkv
     gm = H // Hm
     return [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+        pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
         pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
         pl.BlockSpec((1, 1, n, bk), lambda b, h, i, j, g=gm: (b, h // g, 0, j)),
     ]
@@ -261,7 +268,7 @@ def _fm_fwd(q, k, v, idx, scale, causal, sq, skv, bq, bk):
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(q, k, v, idx)
+    )(q, jnp.swapaxes(k, 2, 3), v, idx)
 
 
 def _fm_bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
@@ -277,13 +284,20 @@ def _fm_bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
 
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B, H, Sqp, 1] like lse
-    io_specs = _fm_specs(B, H, Hm, Hkv, n, bq, bk, D)
+    kt = jnp.swapaxes(k, 2, 3)  # [B, Hkv, D, Skv]: MXU-native recomputes
+    vt = jnp.swapaxes(v, 2, 3)
+    gm = H // Hm
 
     dq = pl.pallas_call(
         functools.partial(_fm_bwd_dq_kernel, scale=scale, causal=causal, n=n,
                           sq=sq, skv=skv, bq=bq, bk=bk, nk=nk),
         grid=(B, H, nq, nk),
-        in_specs=io_specs + [
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
+            pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, n, bk), lambda b, h, i, j, g=gm: (b, h // g, 0, j)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -292,22 +306,21 @@ def _fm_bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
         out_shape=jax.ShapeDtypeStruct((B, H, Sqp, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret_mode(),
-    )(q, k, v, idx, dout, lse, delta)
+    )(q, kt, vt, k, idx, dout, lse, delta)
 
-    kv_specs = [
-        pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i, g=group: (b, h // g, j, 0)),
-        pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i, g=group: (b, h // g, j, 0)),
-        pl.BlockSpec((1, 1, n, bk), lambda b, h, j, i, g=H // Hm: (b, h // g, 0, j)),
-        pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
-    ]
     dk, dv = pl.pallas_call(
         functools.partial(_fm_bwd_dkv_kernel, scale=scale, causal=causal, n=n,
                           sq=sq, skv=skv, bq=bq, bk=bk, nq=nq),
         grid=(B, H, nk, nq),
-        in_specs=kv_specs,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, D, bk), lambda b, h, j, i, g=group: (b, h // g, 0, j)),
+            pl.BlockSpec((1, 1, D, bk), lambda b, h, j, i, g=group: (b, h // g, 0, j)),
+            pl.BlockSpec((1, 1, n, bk), lambda b, h, j, i, g=gm: (b, h // g, 0, j)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+        ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
@@ -321,7 +334,7 @@ def _fm_bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(q, k, v, idx, dout, lse, delta)
+    )(q, kt, vt, idx, dout, lse, delta)
 
     if group > 1:
         dk = dk.reshape(B, Hkv, group, Skvp, D).sum(axis=2)

@@ -184,7 +184,13 @@ def test_c_api_standalone_program(tmp_path):
         ["gcc", str(src), "-o", str(exe), f"-L{os.path.dirname(lib)}",
          "-lpaddle_tpu_c", f"-Wl,-rpath,{os.path.dirname(lib)}"],
         check=True, capture_output=True, text=True)
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": _REPO}
+    # keep pre-existing PYTHONPATH entries EXCEPT the axon sitecustomize:
+    # it force-sets jax_platforms=axon programmatically, which would point
+    # the embedded interpreter at the TPU tunnel and ignore JAX_PLATFORMS
+    extra = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+             if p and ".axon_site" not in p]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.pathsep.join([_REPO] + extra)}
     r = subprocess.run([str(exe), model_path], capture_output=True,
                        text=True, timeout=300, env=env)
     assert r.returncode == 0, (r.stdout, r.stderr)
