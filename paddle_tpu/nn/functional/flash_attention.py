@@ -96,11 +96,33 @@ def scaled_dot_product_attention(
 
         dkey = rnd.next_key()
 
-    if _use_pallas_kernel() and not has_mask and dropout_p == 0.0:
+    # a [B,1,1,Skv]-broadcastable mask is a per-KEY padding mask — the
+    # encoder-model case (BERT/ERNIE) — and rides the Pallas kernel as a
+    # fused additive key bias instead of forcing the S^2-materializing
+    # composite (round-5: this was BERT's bottleneck)
+    key_padding = False
+    if has_mask:
+        mshape = tuple(ins[3].shape)
+        key_padding = (len(mshape) == 4 and mshape[1] == 1 and mshape[2] == 1
+                       and mshape[3] == ins[1].shape[1]
+                       and mshape[0] in (1, ins[0].shape[0]))
+
+    if (_use_pallas_kernel() and dropout_p == 0.0
+            and (not has_mask or key_padding)):
         from ...ops.pallas.flash_attention import flash_attention_fwd
 
-        def fnp(q, k, v):
-            return flash_attention_fwd(q, k, v, causal=is_causal)
+        def fnp(q, k, v, *rest):
+            kb = None
+            if rest:
+                m = rest[0].reshape(rest[0].shape[0], -1)
+                if m.dtype == jnp.bool_:
+                    kb = jnp.where(m, 0.0, -1e30).astype(jnp.float32)
+                else:
+                    kb = m.astype(jnp.float32)
+                if kb.shape[0] == 1 and q.shape[0] > 1:
+                    kb = jnp.broadcast_to(kb, (q.shape[0], kb.shape[1]))
+            return flash_attention_fwd(q, k, v, causal=is_causal,
+                                       key_bias=kb)
 
         return run_op("flash_attention", fnp, ins)
 

@@ -110,9 +110,13 @@ def _block_mask(q_start, k_start, bq, bk, off, causal, pad_k, skv,
 # --------------------------------------------------------------------------- #
 
 
-def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr,
-                *, scale, causal, sq, skv, bq, bk, nk, safe):
+def _fwd_kernel(q_ref, kt_ref, v_ref, *rest_refs,
+                scale, causal, sq, skv, bq, bk, nk, safe, has_kbias):
+    if has_kbias:
+        kb_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest_refs
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest_refs
+        kb_ref = None
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -135,10 +139,18 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
         # MXU-native form (the nt form costs a Mosaic relayout, 2.4x slower)
         q = q_ref[0, 0]
         kt = kt_ref[0, 0]
-        return jax.lax.dot_general(
+        out = jax.lax.dot_general(
             q, kt, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
+        if kb_ref is not None:
+            # additive per-key bias (padding mask): one fused VPU add —
+            # free, since the stream over s is already being paid for.
+            # the bias rides as [B, 8, Skv] (8 replicated sublanes — Mosaic
+            # needs last-two block dims divisible by (8, 128)); row 0 is
+            # broadcast over the tile
+            out = out + kb_ref[0, :1].astype(jnp.float32)
+        return out
 
     def _update_fast(s, v):
         # ONE fused VMEM stream: clamp + exp + row-sum + bf16 cast. No
@@ -224,7 +236,7 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = base + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None):
+def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None, kbias=None):
     B, H, Sqp, D = q.shape
     _, Hkv, Skvp, _ = k.shape
     if bq is None or bk is None:
@@ -237,15 +249,23 @@ def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, sq=sq, skv=skv,
         bq=bq, bk=bk, nk=nk, safe=_safe_softmax(),
+        has_kbias=kbias is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+    ]
+    args = [q, kt, v]
+    if kbias is not None:  # [B, Skvp] additive per-key bias (padding mask)
+        in_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda b, h, i, j: (b, 0, j)))
+        args.append(jnp.broadcast_to(kbias[:, None, :],
+                                     (B, 8, kbias.shape[1])))
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -260,7 +280,7 @@ def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None):
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(q, kt, v)
+    )(*args)
     return out, lse
 
 
@@ -269,22 +289,29 @@ def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None):
 # --------------------------------------------------------------------------- #
 
 
-def _recompute_p(q_ref, kt_ref, lse_ref, scale, safe):
+def _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref=None):
     """One fused stream: s = q@kT (MXU) then exp(s - lse) (VPU). The fast
     forward clamps logits at _CLAMP, so its backward must clamp identically
-    for gradient consistency."""
+    for gradient consistency. kb_ref: optional [1, bk] additive key bias
+    (padding mask) — folded in before the clamp like the forward."""
     s = jax.lax.dot_general(
         q_ref[0, 0], kt_ref[0, 0], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32
     ) * scale
+    if kb_ref is not None:
+        s = s + kb_ref[0, :1].astype(jnp.float32)
     if not safe:
         s = jnp.minimum(s, _CLAMP)
     return jnp.exp(s - lse_ref[0, 0])
 
 
-def _bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, sq, skv, bq, bk, nk,
-                   safe):
+def _bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, *rest_refs, scale, causal,
+                   sq, skv, bq, bk, nk, safe, has_kbias):
+    if has_kbias:
+        kb_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = rest_refs
+    else:
+        do_ref, lse_ref, delta_ref, dq_ref, dq_scr = rest_refs
+        kb_ref = None
     i = pl.program_id(2)
     j = pl.program_id(3)
     q_start = i * bq
@@ -297,7 +324,7 @@ def _bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, do_ref, lse_ref, delta_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _accum(masked):
-        p = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe)
+        p = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref)
         if masked:
             mask = _block_mask(q_start, k_start, bq, bk, off, causal, pad_k,
                                skv)
@@ -349,9 +376,14 @@ def _bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, kt_ref, vt_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, sq, skv, bq, bk, nq, safe):
+def _bwd_dkv_kernel(q_ref, kt_ref, vt_ref, *rest_refs, scale, causal, sq,
+                    skv, bq, bk, nq, safe, has_kbias):
+    if has_kbias:
+        (kb_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr,
+         dv_scr) = rest_refs
+    else:
+        do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest_refs
+        kb_ref = None
     j = pl.program_id(2)  # kv block
     i = pl.program_id(3)  # q block
     q_start = i * bq
@@ -366,7 +398,7 @@ def _bwd_dkv_kernel(q_ref, kt_ref, vt_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _accum(masked):
-        p = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe)
+        p = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref)
         if masked:
             mask = _block_mask(q_start, k_start, bq, bk, off, causal, pad_k,
                                skv, pad_q=pad_q, sq=sq)
@@ -427,7 +459,7 @@ def _bwd_dkv_kernel(q_ref, kt_ref, vt_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
+def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk, kbias=None):
     # (bq, bk) are the FORWARD's (possibly autotuned) block sizes, threaded
     # through the VJP residuals — recomputing defaults here could diverge
     # from the forward's padding and leave grid rows unwritten
@@ -444,38 +476,58 @@ def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B, H, Sqp, 1] like lse
 
+    dq_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
+        pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+    ]
+    dq_args = [q, kt, vt, k]
+    if kbias is not None:
+        dq_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda b, h, i, j: (b, 0, j)))
+        dq_args.append(jnp.broadcast_to(kbias[:, None, :],
+                                        (B, 8, kbias.shape[1])))
+    dq_specs += [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+    ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          sq=sq, skv=skv, bq=bq, bk=bk, nk=nk, safe=safe),
+                          sq=sq, skv=skv, bq=bq, bk=bk, nk=nk, safe=safe,
+                          has_kbias=kbias is not None),
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
-            pl.BlockSpec((1, 1, D, bk), lambda b, h, i, j, g=group: (b, h // g, 0, j)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, g=group: (b, h // g, j, 0)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sqp, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret_mode(),
-    )(q, kt, vt, k, dout, lse, delta)
+    )(*dq_args, dout, lse, delta)
 
     # dk/dv over expanded heads, then group-sum for GQA
+    dkv_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, D, bk), lambda b, h, j, i, g=group: (b, h // g, 0, j)),
+        pl.BlockSpec((1, 1, D, bk), lambda b, h, j, i, g=group: (b, h // g, 0, j)),
+    ]
+    dkv_args = [q, kt, vt]
+    if kbias is not None:
+        dkv_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda b, h, j, i: (b, 0, j)))
+        dkv_args.append(jnp.broadcast_to(kbias[:, None, :],
+                                         (B, 8, kbias.shape[1])))
+    dkv_specs += [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+    ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          sq=sq, skv=skv, bq=bq, bk=bk, nq=nq, safe=safe),
+                          sq=sq, skv=skv, bq=bq, bk=bk, nq=nq, safe=safe,
+                          has_kbias=kbias is not None),
         grid=(B, H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, D, bk), lambda b, h, j, i, g=group: (b, h // g, 0, j)),
-            pl.BlockSpec((1, 1, D, bk), lambda b, h, j, i, g=group: (b, h // g, 0, j)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
@@ -489,7 +541,7 @@ def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk):
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(q, kt, vt, dout, lse, delta)
+    )(*dkv_args, dout, lse, delta)
 
     if group > 1:
         dk = dk.reshape(B, Hkv, group, Skvp, D).sum(axis=2)
@@ -514,6 +566,51 @@ def _pad_seq(x, block):
 def _flash(q, k, v, causal, scale, bq, bk):
     out, _ = _flash_fwd_res(q, k, v, causal, scale, bq, bk)
     return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_kb(q, k, v, kbias, causal, scale, bq, bk):
+    """Variant with an additive per-key bias [B, Skv] (padding mask)."""
+    out, _ = _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk)
+    return out
+
+
+def _pad_kbias(kbias, skv, block):
+    pad = (-skv) % block
+    if pad:
+        # padded key columns must stay masked even without the pad_k mask
+        kbias = jnp.pad(kbias, ((0, 0), (0, pad)), constant_values=NEG_INF)
+    return kbias
+
+
+def _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk):
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    qp = _pad_seq(q, bq)
+    kp = _pad_seq(k, bk)
+    vp = _pad_seq(v, bk)
+    kbp = _pad_kbias(kbias.astype(jnp.float32), Skv, bk)
+    out, lse = _fwd(qp, kp, vp, scale, causal, Sq, Skv, bq=bq, bk=bk,
+                    kbias=kbp)
+    return out[:, :, :Sq], (qp, kp, vp, kbp, out, lse)
+
+
+def _flash_kb_vjp_fwd(q, k, v, kbias, causal, scale, bq, bk):
+    out, res = _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk)
+    return out, (res, q.shape[2], k.shape[2])
+
+
+def _flash_kb_vjp_bwd(causal, scale, bq, bk, saved, dout):
+    (qp, kp, vp, kbp, outp, lse), sq, skv = saved
+    dop = jnp.pad(dout, ((0, 0), (0, 0), (0, qp.shape[2] - sq), (0, 0)))
+    dq, dk, dv = _bwd(scale, causal, sq, skv, (qp, kp, vp, outp, lse), dop,
+                      bq, bk, kbias=kbp)
+    # the mask is data, not a trained parameter — zero cotangent
+    return (dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv],
+            jnp.zeros((kbp.shape[0], skv), kbp.dtype))
+
+
+_flash_kb.defvjp(_flash_kb_vjp_fwd, _flash_kb_vjp_bwd)
 
 
 def _tuned_blocks(q, k, v, causal, scale):
@@ -568,9 +665,11 @@ def _flash_vjp_bwd(causal, scale, bq, bk, saved, dout):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention_fwd(q, k, v, causal=False, scale=None):
+def flash_attention_fwd(q, k, v, causal=False, scale=None, key_bias=None):
     """Paddle-layout entry: q [B,Sq,H,D], k/v [B,Skv,Hkv,D] → [B,Sq,H,D].
 
+    key_bias: optional [B, Skv] ADDITIVE per-key bias (the padding-mask
+    case — encoder models), fused into the kernel's logits stream.
     Differentiable (custom VJP, flash backward). Reference API:
     python/paddle/nn/functional/flash_attention.py:358."""
     if scale is None:
@@ -583,7 +682,10 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     bq, bk = _tuned_blocks(qt, kt, vt, causal, scale)
-    out = _flash(qt, kt, vt, causal, scale, bq, bk)
+    if key_bias is not None:
+        out = _flash_kb(qt, kt, vt, key_bias, causal, scale, bq, bk)
+    else:
+        out = _flash(qt, kt, vt, causal, scale, bq, bk)
     return jnp.swapaxes(out, 1, 2)
 
 

@@ -298,3 +298,35 @@ def test_paged_decode_parity(B, H, Hkv, D, ps, P):
                 gv[b, :, p * ps:(p + 1) * ps] = np.asarray(vc[tables[b, p]])
     ref = _decode_ref(q, jnp.asarray(gk), jnp.asarray(gv), jnp.asarray(lens))
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
+
+
+def test_flashmask_bf16_parity():
+    """bf16 operands (the AMP O2 path — round-5 made the kernels feed the
+    MXU native dtypes, so the casts are no longer no-ops under f32)."""
+    rng = np.random.default_rng(7)
+    B, S, H, D, n = 1, 128, 2, 32, 2
+    qf = rng.standard_normal((B, S, H, D)).astype(np.float32) * 0.5
+    kf = rng.standard_normal((B, S, H, D)).astype(np.float32) * 0.5
+    vf = rng.standard_normal((B, S, H, D)).astype(np.float32) * 0.5
+    idx = _causal_doc_mask_idx(rng, B, 1, S, n)
+    q, k, v = (jnp.asarray(x, jnp.bfloat16) for x in (qf, kf, vf))
+    keep = _flashmask_keep_ref(np.asarray(idx), S, S, True)
+    out = flashmask_attention_fwd(q, k, v, idx, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _masked_ref(jnp.asarray(np.asarray(q, np.float32)),
+                      jnp.asarray(np.asarray(k, np.float32)),
+                      jnp.asarray(np.asarray(v, np.float32)), keep)
+    # bf16 tolerance: ~8 mantissa bits
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.06, atol=0.06)
+    g = jnp.ones(out.shape, jnp.bfloat16)
+    gq, gk, gv = jax.grad(
+        lambda a, b, c: (flashmask_attention_fwd(a, b, c, idx, causal=True)
+                         .astype(jnp.float32) * g.astype(jnp.float32)).sum(),
+        (0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(
+        lambda a, b, c: (_masked_ref(a, b, c, keep)).sum(), (0, 1, 2))(
+        jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf))
+    for got, want, name in ((gq, rq, "dq"), (gk, rk, "dk"), (gv, rv, "dv")):
+        d = np.abs(np.asarray(got, np.float32) - np.asarray(want)).max()
+        assert d < 0.08, (name, d)
