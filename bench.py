@@ -5,12 +5,13 @@ ONE JSON line {"metric", "value", "unit", "vs_baseline"} (MFU; north star
 >=45% so vs_baseline = mfu / 0.45).
 
 BENCH_CONFIG=<rung> runs a single named rung. BENCH_MATRIX=1 runs the
-BASELINE.md matrix (gpt3 headline + llama flashmask + bert-base + resnet50),
+BASELINE.md matrix (gpt3 headline + llama flashmask + bert-base +
+resnet50 + SD-scale unet),
 one JSON line per rung, headline line LAST so drivers reading the final line
 still get the headline.
 
 Rungs: gpt3_1p3b gpt3_350m gpt3_125m llama_7bshape bert_base resnet50
-cpu_smoke.
+unet_sd cpu_smoke.
 """
 
 import json
@@ -335,6 +336,58 @@ def run_bert_rung(on_tpu):
     return _emit(f"bert_base_bs{batch}x{seq}", dt, flops, batch * seq)
 
 
+def run_unet_rung(on_tpu):
+    """Stable-Diffusion-style UNet denoising step (BASELINE.md 'Stable
+    Diffusion UNet: conv + cross-attn' row). SD-scale channel stack
+    (320/640/1280, cross-attn context 768) at the 64x64x4 latent shape;
+    throughput metric is latents/sec (MFU for a conv+attn hybrid is not
+    comparable to the decoder rungs)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import UNetConfig, UNetModel, unet_tiny
+
+    if on_tpu:
+        cfg = UNetConfig(in_channels=4, out_channels=4, base_channels=320,
+                         channel_mult=(1, 2, 4), num_res_blocks=2,
+                         attention_levels=(1, 2), num_heads=8,
+                         context_dim=768)
+        batch, hw, ctx_len, steps = 8, 64, 77, 10
+    else:
+        cfg = unet_tiny()
+        batch, hw, ctx_len, steps = 2, 8, 4, 3
+    paddle.seed(0)
+    model = UNetModel(cfg)
+    mse = nn.MSELoss()
+    optimizer = opt.AdamW(learning_rate=1e-4, moment_dtype="bfloat16",
+                          parameters=model.parameters())
+    mesh = dist.build_mesh(devices=jax.devices()[:1])
+    step = dist.DistributedTrainStep(
+        model, lambda pred, target: mse(pred, target), optimizer, mesh=mesh,
+        amp_level="O2" if on_tpu else None, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    noisy = paddle.to_tensor(
+        rng.normal(size=(batch, cfg.in_channels, hw, hw)).astype(np.float32))
+    t = paddle.to_tensor(rng.integers(0, 1000, (batch,)))
+    ctx = paddle.to_tensor(
+        rng.normal(size=(batch, ctx_len, cfg.context_dim)).astype(np.float32))
+    noise = paddle.to_tensor(
+        rng.normal(size=(batch, cfg.out_channels, hw, hw)).astype(np.float32))
+    _ = float(step([noisy, t, ctx], noise))
+    dt = _timed_steps(lambda: step([noisy, t, ctx], noise), steps)
+    peak, kind = _peak_flops(jax.devices()[0])
+    line = {
+        "metric": f"unet_sd_bs{batch}x{hw}_{kind.replace(' ', '_')}",
+        "value": round(batch / dt, 2),
+        "unit": "latents_per_sec",
+        "vs_baseline": 0.0,  # reference publishes no UNet number
+        "step_time_s": round(dt, 4),
+    }
+    print(json.dumps(line), flush=True)
+    return line
+
+
 def run_resnet_rung(on_tpu):
     """ResNet-50 ImageNet train step (BASELINE.md first-slice row)."""
     import paddle_tpu as paddle
@@ -393,7 +446,8 @@ def main():
         results = []
         for rung_name, rung in (("llama", run_llama_rung),
                                 ("bert", run_bert_rung),
-                                ("resnet", run_resnet_rung)):
+                                ("resnet", run_resnet_rung),
+                                ("unet", run_unet_rung)):
             try:
                 results.append(rung(on_tpu))
             except Exception as e:
@@ -416,6 +470,8 @@ def main():
         run_bert_rung(on_tpu)
     elif cfg_name == "resnet50":
         run_resnet_rung(on_tpu)
+    elif cfg_name == "unet_sd":
+        run_unet_rung(on_tpu)
     else:
         run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir)
 

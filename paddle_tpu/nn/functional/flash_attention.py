@@ -105,7 +105,11 @@ def scaled_dot_product_attention(
         mshape = tuple(ins[3].shape)
         key_padding = (len(mshape) == 4 and mshape[1] == 1 and mshape[2] == 1
                        and mshape[3] == ins[1].shape[1]
-                       and mshape[0] in (1, ins[0].shape[0]))
+                       and mshape[0] in (1, ins[0].shape[0])
+                       # a LEARNED bias needs its gradient, which the
+                       # kernel's key-bias path does not produce — keep the
+                       # exact composite for trainable masks
+                       and getattr(ins[3], "stop_gradient", True))
 
     if (_use_pallas_kernel() and dropout_p == 0.0
             and (not has_mask or key_padding)):

@@ -258,10 +258,10 @@ def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None, kbias=None):
     ]
     args = [q, kt, v]
     if kbias is not None:  # [B, Skvp] additive per-key bias (padding mask)
-        in_specs.append(
-            pl.BlockSpec((1, 8, bk), lambda b, h, i, j: (b, 0, j)))
-        args.append(jnp.broadcast_to(kbias[:, None, :],
-                                     (B, 8, kbias.shape[1])))
+        spec, arg = _kbias_spec_and_arg(kbias, B, bk,
+                                        lambda b, h, i, j: (b, 0, j))
+        in_specs.append(spec)
+        args.append(arg)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
@@ -484,10 +484,10 @@ def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk, kbias=None):
     ]
     dq_args = [q, kt, vt, k]
     if kbias is not None:
-        dq_specs.append(
-            pl.BlockSpec((1, 8, bk), lambda b, h, i, j: (b, 0, j)))
-        dq_args.append(jnp.broadcast_to(kbias[:, None, :],
-                                        (B, 8, kbias.shape[1])))
+        spec, arg = _kbias_spec_and_arg(kbias, B, bk,
+                                        lambda b, h, i, j: (b, 0, j))
+        dq_specs.append(spec)
+        dq_args.append(arg)
     dq_specs += [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
         pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -513,10 +513,10 @@ def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk, kbias=None):
     ]
     dkv_args = [q, kt, vt]
     if kbias is not None:
-        dkv_specs.append(
-            pl.BlockSpec((1, 8, bk), lambda b, h, j, i: (b, 0, j)))
-        dkv_args.append(jnp.broadcast_to(kbias[:, None, :],
-                                         (B, 8, kbias.shape[1])))
+        spec, arg = _kbias_spec_and_arg(kbias, B, bk,
+                                        lambda b, h, j, i: (b, 0, j))
+        dkv_specs.append(spec)
+        dkv_args.append(arg)
     dkv_specs += [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
         pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
@@ -570,9 +570,23 @@ def _flash(q, k, v, causal, scale, bq, bk):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash_kb(q, k, v, kbias, causal, scale, bq, bk):
-    """Variant with an additive per-key bias [B, Skv] (padding mask)."""
+    """Variant with an additive per-key bias [B, Skv] (padding mask).
+
+    The bias is treated as DATA: its cotangent is zero (callers with a
+    trainable bias must use the composite path — the functional dispatch
+    checks stop_gradient for exactly this)."""
     out, _ = _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk)
     return out
+
+
+def _kbias_spec_and_arg(kbias, B, bk, index_map):
+    """BlockSpec + operand for the key bias: [B, 8, Skvp] with 8 replicated
+    sublanes (Mosaic wants last-two block dims divisible by (8, 128));
+    kernels read row 0 and broadcast. ONE definition — the fwd and both bwd
+    kernels must stay tiled identically."""
+    spec = pl.BlockSpec((1, 8, bk), index_map)
+    arg = jnp.broadcast_to(kbias[:, None, :], (B, 8, kbias.shape[1]))
+    return spec, arg
 
 
 def _pad_kbias(kbias, skv, block):
@@ -605,9 +619,10 @@ def _flash_kb_vjp_bwd(causal, scale, bq, bk, saved, dout):
     dop = jnp.pad(dout, ((0, 0), (0, 0), (0, qp.shape[2] - sq), (0, 0)))
     dq, dk, dv = _bwd(scale, causal, sq, skv, (qp, kp, vp, outp, lse), dop,
                       bq, bk, kbias=kbp)
-    # the mask is data, not a trained parameter — zero cotangent
+    # the mask is data, not a trained parameter — zero cotangent; primal
+    # kbias is f32 by construction (entry casts), so dtypes always match
     return (dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv],
-            jnp.zeros((kbp.shape[0], skv), kbp.dtype))
+            jnp.zeros((kbp.shape[0], skv), jnp.float32))
 
 
 _flash_kb.defvjp(_flash_kb_vjp_fwd, _flash_kb_vjp_bwd)
@@ -683,7 +698,9 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, key_bias=None):
     vt = jnp.swapaxes(v, 1, 2)
     bq, bk = _tuned_blocks(qt, kt, vt, causal, scale)
     if key_bias is not None:
-        out = _flash_kb(qt, kt, vt, key_bias, causal, scale, bq, bk)
+        # f32 primal by construction: the zero cotangent in the VJP is f32
+        out = _flash_kb(qt, kt, vt, key_bias.astype(jnp.float32), causal,
+                        scale, bq, bk)
     else:
         out = _flash(qt, kt, vt, causal, scale, bq, bk)
     return jnp.swapaxes(out, 1, 2)
