@@ -106,3 +106,34 @@ def test_two_processes(tmp_path):
     outs = [p.communicate(timeout=120) for p in procs]
     assert all(p.returncode == 0 for p in procs), outs
     assert "RPC_OK 42" in outs[0][0], outs
+
+
+def _slow(seconds):
+    import time
+    time.sleep(seconds)
+    return "done"
+
+
+class TestTimeouts:
+    def setup_method(self, m):
+        rpc.init_rpc("w0", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{_free_port()}")
+
+    def teardown_method(self, m):
+        rpc.shutdown()
+
+    def test_call_timeout_raises(self):
+        with pytest.raises(Exception) as ei:
+            rpc.rpc_sync("w0", _slow, args=(2,), timeout=0.5)
+        assert "timed out" in str(ei.value).lower() or isinstance(
+            ei.value, (TimeoutError, OSError)), ei.value
+
+    def test_async_timeout_surfaces_in_future(self):
+        fut = rpc.rpc_async("w0", _slow, args=(2,), timeout=0.5)
+        with pytest.raises(Exception) as ei:
+            fut.wait()
+        assert "timed out" in str(ei.value).lower() or isinstance(
+            ei.value, (TimeoutError, OSError)), ei.value
+
+    def test_fast_call_within_timeout(self):
+        assert rpc.rpc_sync("w0", _double, args=(4,), timeout=30) == 8
