@@ -48,7 +48,8 @@ from .env import (
 )
 from .parallel import DataParallel, group_sharded_parallel
 from .train_step import DistributedTrainStep
-from . import auto_parallel, checkpoint
+from . import auto_parallel, checkpoint, resilience
+from .resilience import ResilientTrainer, run_with_recovery
 from .auto_parallel import (
     Partial,
     ProcessMesh,
@@ -72,6 +73,7 @@ __all__ = [
     "isend", "irecv", "barrier", "wait", "P2POp", "batch_isend_irecv",
     "destroy_process_group", "fleet", "collective", "DataParallel",
     "group_sharded_parallel", "DistributedTrainStep", "sharding",
+    "resilience", "ResilientTrainer", "run_with_recovery",
 ]
 
 

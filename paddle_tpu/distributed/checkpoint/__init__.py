@@ -1,6 +1,23 @@
-from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
+from .metadata import (  # noqa: F401
+    COMMIT_FILE,
+    CheckpointCorruptError,
+    LocalTensorIndex,
+    LocalTensorMetadata,
+    Metadata,
+)
 from .load_state_dict import load_state_dict  # noqa: F401
 from .save_state_dict import save_state_dict  # noqa: F401
+from .manager import (  # noqa: F401
+    CheckpointInfo,
+    CheckpointManager,
+    checkpoint_steps,
+    latest_checkpoint,
+    validate_checkpoint,
+    wait_async_save,
+)
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata",
-           "LocalTensorMetadata", "LocalTensorIndex"]
+           "LocalTensorMetadata", "LocalTensorIndex", "CheckpointCorruptError",
+           "COMMIT_FILE", "CheckpointInfo", "CheckpointManager",
+           "checkpoint_steps", "latest_checkpoint", "validate_checkpoint",
+           "wait_async_save"]

@@ -8,6 +8,12 @@ TPU-native: for each target tensor we assemble the needed region from saved
 shard files and `jax.make_array_from_callback` places it under the CURRENT
 sharding — a checkpoint written under one (dp, mp, pp...) config loads under
 any other (the reshard happens in the addressing, no collective needed).
+
+Integrity: shard files are verified against the crc32 recorded in the
+metadata the first time they are opened, and each shard array against its
+per-shard crc32 as it is read — a truncated or bit-flipped file raises
+CheckpointCorruptError naming the file, never loads silently. Legacy
+checkpoints without checksums still load (nothing to verify against).
 """
 
 from __future__ import annotations
@@ -18,27 +24,88 @@ import jax
 import numpy as np
 
 from ...framework.core import Tensor
-from .metadata import Metadata, metadata_path
+from .metadata import (
+    CheckpointCorruptError,
+    Metadata,
+    crc32_file,
+    crc32_of,
+    metadata_path,
+)
 
 __all__ = ["load_state_dict"]
 
 
-def _assemble(meta_list, global_shape, files_cache, path, region=None):
+def _open_shard_file(path, fname, files_cache, file_checksums, files_crc_ok):
+    """Verify + open a shard file once, caching the (lazy) npz handle. The
+    crc pass streams the on-disk bytes in chunks and np.load then reads
+    members lazily from disk — peak memory stays one assembled tensor, not
+    the whole file. Files that pass the file-level crc are recorded in
+    `files_crc_ok`: their bytes are already proven intact, so the per-shard
+    crcs (a fallback for metadata lacking file checksums) can be skipped."""
+    fpath = os.path.join(path, fname)
+    if fpath in files_cache:
+        return files_cache[fpath]
+    expected = file_checksums.get(fname, "")
+    try:
+        if expected:
+            got = crc32_file(fpath)
+            if got != expected:
+                raise CheckpointCorruptError(
+                    f"checkpoint shard file corrupt (checksum mismatch): "
+                    f"{fpath} (expected {expected}, got {got})")
+            files_crc_ok.add(fname)
+    except OSError as e:
+        raise CheckpointCorruptError(
+            f"checkpoint shard file missing/unreadable: {fpath} ({e})") from e
+    try:
+        npz = np.load(fpath)
+    except FileNotFoundError as e:
+        # reachable for legacy checkpoints with no file checksum to probe
+        raise CheckpointCorruptError(
+            f"checkpoint shard file missing: {fpath} ({e})") from e
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint shard file unparseable (truncated write?): {fpath} "
+            f"({e})") from e
+    files_cache[fpath] = npz
+    return npz
+
+
+def _assemble(meta_list, global_shape, files_cache, path, region=None,
+              file_checksums=None, verified=None, files_crc_ok=None):
     """Assemble (a region of) the global tensor from saved shards.
 
-    region: tuple of slices (None = full tensor).
+    region: tuple of slices (None = full tensor). `verified` collects
+    (file, key) pairs whose per-shard crc already passed — the reshard
+    callback runs once per device and must not re-hash the same shard D
+    times; `files_crc_ok` skips per-shard crcs entirely for files whose
+    file-level crc already proved every byte.
     """
     if region is None:
         region = tuple(slice(0, s) for s in global_shape)
     out_shape = tuple(sl.stop - sl.start for sl in region)
     out = None
+    files_crc_ok = files_crc_ok if files_crc_ok is not None else set()
     for m in meta_list:
         if out is None:
             out = np.zeros(out_shape, np.dtype(m.dtype))
-        fpath = os.path.join(path, m.file_name)
-        if fpath not in files_cache:
-            files_cache[fpath] = np.load(fpath)
-        data = files_cache[fpath][m.key]
+        npz = _open_shard_file(path, m.file_name, files_cache,
+                               file_checksums or {}, files_crc_ok)
+        try:
+            data = npz[m.key]
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"shard '{m.key}' unreadable in "
+                f"{os.path.join(path, m.file_name)} ({e})") from e
+        vkey = (m.file_name, m.key)
+        if m.checksum and m.file_name not in files_crc_ok \
+                and (verified is None or vkey not in verified):
+            if crc32_of(np.ascontiguousarray(data)) != m.checksum:
+                raise CheckpointCorruptError(
+                    f"shard '{m.key}' corrupt (checksum mismatch) in "
+                    f"{os.path.join(path, m.file_name)}")
+            if verified is not None:
+                verified.add(vkey)
         # overlap of [offset, offset+shape) with region
         src_sl, dst_sl = [], []
         empty = False
@@ -61,8 +128,18 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, offload=False):
     """Fill `state_dict`'s tensors in place from the checkpoint at `path`,
     resharding saved shards onto each tensor's current sharding."""
-    meta = Metadata.load(metadata_path(path))
+    try:
+        meta = Metadata.load(metadata_path(path))
+    except OSError as e:
+        raise CheckpointCorruptError(
+            f"checkpoint metadata missing/unreadable: {metadata_path(path)} "
+            f"({e}) — was this save interrupted before commit?") from e
+    except (ValueError, KeyError, TypeError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint metadata corrupt: {metadata_path(path)} ({e!r})") from e
     files_cache = {}
+    verified = set()
+    files_crc_ok = set()
     for name, t in state_dict.items():
         if name not in meta.state_dict_metadata:
             raise KeyError(f"{name} not found in checkpoint {path}")
@@ -78,11 +155,17 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     slice(0 if sl.start is None else sl.start,
                           _gshape[d] if sl.stop is None else sl.stop)
                     for d, sl in enumerate(index))
-                return _assemble(_entries, _gshape, files_cache, path, region)
+                return _assemble(_entries, _gshape, files_cache, path, region,
+                                 file_checksums=meta.file_checksums,
+                                 verified=verified,
+                                 files_crc_ok=files_crc_ok)
 
             arr = jax.make_array_from_callback(tuple(gshape), sharding, cb)
         else:
-            full = _assemble(entries, gshape, files_cache, path)
+            full = _assemble(entries, gshape, files_cache, path,
+                             file_checksums=meta.file_checksums,
+                             verified=verified,
+                             files_crc_ok=files_crc_ok)
             arr = jax.numpy.asarray(full)
             # replicate onto the target's mesh only if the target is actually
             # multi-device; committing to a single device would poison later

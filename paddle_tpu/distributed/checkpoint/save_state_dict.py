@@ -1,4 +1,4 @@
-"""Sharded checkpoint save.
+"""Sharded checkpoint save — crash-safe commit protocol.
 
 Reference: python/paddle/distributed/checkpoint/save_state_dict.py:135 —
 every rank writes the shards it owns plus rank-0 writes a metadata file
@@ -10,17 +10,42 @@ with its addressable unique shards (multi-host: each host persists only its
 slice — no cross-host traffic), and process 0 writes `0.metadata`. Dedup of
 replicated shards follows the reference's coordinator rule: the lowest
 process id owning a shard writes it.
+
+Commit protocol (crash safety): nothing is ever written into `path` itself.
+All files land in `path + ".tmp"`; after shards and metadata are written and
+fsync'd the coordinator drops a COMMIT marker and renames the directory to
+`path` in one atomic step. A save killed at ANY instant leaves either the
+previous committed checkpoint untouched, or a `.tmp` directory that
+discovery (`latest_checkpoint`) ignores and the next save sweeps away — no
+manual cleanup ever required. Shard files and metadata carry crc32 checksums
+so on-disk corruption after commit is also detected at load.
+
+`async_save=True` snapshots device arrays to host immediately (so the train
+step can keep mutating them) and runs the write+commit phase on a background
+thread, double-buffered: at most one save is in flight, and submitting the
+next one first drains the previous. Multi-process runs fall back to
+synchronous saves — the metadata all-gather doubles as the "all shards
+written" barrier and must not race the training step's collectives.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 
 import jax
 import numpy as np
 
 from ...framework.core import Tensor
-from .metadata import LocalTensorMetadata, Metadata, metadata_path
+from .. import faults
+from .metadata import (
+    COMMIT_FILE,
+    LocalTensorMetadata,
+    Metadata,
+    crc32_file,
+    crc32_of,
+    metadata_path,
+)
 
 __all__ = ["save_state_dict"]
 
@@ -29,9 +54,10 @@ def _shard_key(name, offset):
     return name + "|" + ",".join(map(str, offset))
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    unique_id=None, async_save=False):
-    os.makedirs(path, exist_ok=True)
+def _snapshot(state_dict):
+    """Device→host snapshot: shard arrays (np copies), metadata entries, and
+    the shard file name this process will write. Runs on the caller's thread
+    so an async save is immune to later in-place updates of the tensors."""
     pid = jax.process_index()
     fname = f"{pid}_0.distcp"
     shards = {}
@@ -61,35 +87,172 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 if min(owners) != pid:
                     continue
                 seen_offsets.add(offset)
-                data = np.asarray(shard.data)
+                # copy=True is load-bearing: np.asarray of a jax.Array can be
+                # a zero-copy VIEW of the XLA buffer, and with buffer
+                # donation the next train step reuses that memory while the
+                # async writer is still serializing it
+                data = np.array(shard.data, copy=True)
                 key = _shard_key(name, offset)
                 shards[key] = data
+                # checksum filled in by _write_and_commit — hashing belongs
+                # on the (possibly background) write thread, not here on the
+                # train thread
                 entries.append(LocalTensorMetadata(
                     offset, tuple(data.shape), str(data.dtype), fname, key))
         else:
-            data = np.asarray(v)
+            data = np.array(v, copy=True)  # see copy=True note above
             key = _shard_key(name, (0,) * data.ndim)
             shards[key] = data
             entries.append(LocalTensorMetadata(
-                (0,) * data.ndim, tuple(data.shape), str(data.dtype), fname, key))
+                (0,) * data.ndim, tuple(data.shape), str(data.dtype), fname,
+                key))
         if entries:
             meta_entries[name] = entries
+    return shards, meta_entries, global_shapes, fname
 
-    with open(os.path.join(path, fname), "wb") as f:
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_and_commit(plan, path, coordinator_rank, post_commit=None):
+    shards, meta_entries, global_shapes, fname = plan
+    pid = jax.process_index()
+    nproc = jax.process_count()
+    is_coord = pid == coordinator_rank or nproc == 1
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    # NO rmtree of a stale tmp here: with nproc>1 a peer may already be
+    # writing its shard into tmp before the coordinator arrives, and
+    # deleting the dir would eat that live shard. Same-named files simply
+    # overwrite their stale versions; leftover strays from a crashed save
+    # are swept by the coordinator after the gather (when every peer has
+    # provably finished writing).
+    os.makedirs(tmp, exist_ok=True)
+    if is_coord:
+        # a save that died between COMMIT and rename leaves a committed-
+        # looking tmp; drop the marker first so the rebuilt tmp can never
+        # be mistaken for complete before this save's own commit
+        try:
+            os.unlink(os.path.join(tmp, COMMIT_FILE))
+        except OSError:
+            pass
+
+    faults.fault_point("ckpt.before_shards")
+    # per-shard crcs (defense in depth for metadata whose file-level crc is
+    # missing): computed here so the hashing cost lands on this (possibly
+    # background) thread, off the train step's critical path
+    for entries in meta_entries.values():
+        for e in entries:
+            e.checksum = crc32_of(shards[e.key])
+    # stream the npz straight to disk (no in-memory container copy — the
+    # snapshot alone is already one full host copy of the shards), then crc
+    # the written file: the recorded checksum covers the exact on-disk bytes
+    fpath = os.path.join(tmp, fname)
+    with open(fpath, "wb") as f:
         np.savez(f, **shards)  # exact name (np.savez would append .npz)
+        f.flush()
+        os.fsync(f.fileno())
+    file_crc = crc32_file(fpath)
+    faults.fault_point("ckpt.mid_save")  # shards on disk, metadata absent
 
+    file_checksums = {fname: file_crc}
     # merge metadata across processes: single-host writes directly; multi-host
-    # uses the all-gather-object collective (process 0 persists)
-    if jax.process_count() > 1:
+    # uses the all-gather-object collective (process 0 persists). The gather
+    # is also the barrier proving every process finished its shard file —
+    # COMMIT must never cover a file still being written on another host.
+    if nproc > 1:
         from ..collective import all_gather_object
 
         gathered = []
-        all_gather_object(gathered, (meta_entries, global_shapes))
-        merged, shapes = {}, {}
-        for me, gs in gathered:
+        all_gather_object(gathered, (meta_entries, global_shapes, file_checksums))
+        merged, shapes, crcs = {}, {}, {}
+        for me, gs, fc in gathered:
             shapes.update(gs)
+            crcs.update(fc)
             for k, v in me.items():
                 merged.setdefault(k, []).extend(v)
-        meta_entries, global_shapes = merged, shapes
-    if pid == coordinator_rank or jax.process_count() == 1:
-        Metadata(meta_entries, global_shapes).save(metadata_path(path))
+        meta_entries, global_shapes, file_checksums = merged, shapes, crcs
+
+    if is_coord:
+        # sweep strays from a previous crashed save of this same step: by
+        # this point the gather proved every peer finished writing, and the
+        # gathered file set is exactly what this save owns
+        keep = set(file_checksums) | {os.path.basename(metadata_path(tmp))}
+        for stray in os.listdir(tmp):
+            if stray not in keep and stray != COMMIT_FILE:
+                try:
+                    os.unlink(os.path.join(tmp, stray))
+                except OSError:
+                    pass
+        Metadata(meta_entries, global_shapes,
+                 file_checksums=file_checksums).save(metadata_path(tmp))
+        faults.fault_point("ckpt.before_commit")  # metadata written, no COMMIT
+        with open(os.path.join(tmp, COMMIT_FILE), "w") as f:
+            f.write('{"format": 1}\n')
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        faults.fault_point("ckpt.before_rename")  # committed, not yet visible
+        if os.path.isdir(path):
+            _replace_into(tmp, path)
+        else:
+            os.rename(tmp, path)
+        _fsync_dir(parent)
+    if nproc > 1:
+        # post-commit barrier: without it a peer could start its NEXT save
+        # into the same shared tmp dir while the coordinator is still
+        # between the gather and the rename, committing foreign bytes under
+        # this save's metadata
+        from ..collective import all_gather_object
+
+        all_gather_object([], ("commit_done", path))
+    if is_coord and post_commit is not None:
+        post_commit()
+
+
+def _replace_into(tmp, path):
+    """Overwrite an EXISTING checkpoint dir without deleting unrelated files
+    a user may keep alongside it (the pre-hardening save wrote in place and
+    preserved them — rmtree'ing the dir would be silent data loss). Not a
+    single atomic rename, but ordered for the same guarantee: the old COMMIT
+    falls first, the new one lands last, so the dir is never valid with
+    mixed contents."""
+    try:
+        os.unlink(os.path.join(path, COMMIT_FILE))
+    except OSError:
+        pass
+    for name in os.listdir(tmp):
+        if name != COMMIT_FILE:
+            os.replace(os.path.join(tmp, name), os.path.join(path, name))
+    _fsync_dir(path)  # data entries durable BEFORE the marker lands...
+    os.replace(os.path.join(tmp, COMMIT_FILE), os.path.join(path, COMMIT_FILE))
+    _fsync_dir(path)  # ...and the marker durable before save() returns
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, async_save=False, _post_commit=None):
+    """Save `state_dict` to the directory `path` (atomically committed).
+
+    With `async_save=True` (single-process only) returns a handle whose
+    `.result()` waits for the commit; `checkpoint.wait_async_save()` drains
+    the in-flight save globally.
+    """
+    plan = _snapshot(state_dict)
+    if async_save and jax.process_count() == 1:
+        from .manager import _async_saver
+
+        return _async_saver.submit(
+            lambda: _write_and_commit(plan, path, coordinator_rank,
+                                      post_commit=_post_commit))
+    _write_and_commit(plan, path, coordinator_rank, post_commit=_post_commit)
+    return None

@@ -25,66 +25,144 @@ __all__ = ["enable", "disable", "comm_task", "drain_report", "timeout_count",
 
 _wd = None
 _lock = threading.Lock()
+_spill = None  # (thread, stop_event)
 
 
-def enable(timeout_seconds=None):
+def _spill_once(path, fatal):
+    report = drain_report()
+    if not report:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(report)
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        # the drain already emptied the native buffer — losing the report
+        # here would erase the only record of the hang; stderr (→ worker
+        # log) is the fallback channel
+        import sys
+
+        print(f"[comm_watchdog] report file {path} unwritable ({e}); "
+              f"report follows:\n{report}", file=sys.stderr, flush=True)
+    if fatal:
+        # a hung step can't log its own death — this line, written by the
+        # spill thread, is what the launcher's LogWatcher pattern-matches to
+        # tear the wedged pod down and restart it (launch/main.py)
+        import sys
+
+        print("FatalError: comm watchdog deadline exceeded\n" + report,
+              file=sys.stderr, flush=True)
+
+
+def _spill_loop(stop, path, fatal, interval=0.5):
+    while not stop.wait(interval):
+        if _wd is None:
+            return
+        _spill_once(path, fatal)
+
+
+def enable(timeout_seconds=None, report_file=None):
     """Start the watchdog (idempotent). Default timeout from
-    FLAGS_pg_timeout-equivalent env PADDLE_PG_TIMEOUT (seconds, default 1800)."""
-    global _wd
+    FLAGS_pg_timeout-equivalent env PADDLE_PG_TIMEOUT (seconds, default 1800).
+
+    When `report_file` (or env PADDLE_WD_REPORT_FILE — set per worker by the
+    launcher) is given, a spill thread appends every timeout report to that
+    file as it happens, so a worker that hangs and is later killed still
+    leaves its post-mortem on disk. With PADDLE_WD_FATAL=1 the spill also
+    prints a FatalError line to stderr, which the launcher's log watcher
+    treats as grounds to tear down and restart the hung pod."""
+    global _wd, _spill
     with _lock:
-        if _wd is not None:
-            return True
-        lib = native.load()
-        if lib is None:
-            return False
-        if timeout_seconds is None:
-            timeout_seconds = float(os.environ.get("PADDLE_PG_TIMEOUT", "1800"))
-        _wd = (lib, lib.watchdog_create(int(timeout_seconds * 1000)))
+        if _wd is None:
+            lib = native.load()
+            if lib is None:
+                return False
+            if timeout_seconds is None:
+                timeout_seconds = float(
+                    os.environ.get("PADDLE_PG_TIMEOUT", "1800"))
+            _wd = (lib, lib.watchdog_create(int(timeout_seconds * 1000)))
+        # the spill thread starts whenever a report file is configured and
+        # none is running yet — including on a repeat enable() after an
+        # earlier caller enabled the watchdog without one
+        report_file = report_file or os.environ.get("PADDLE_WD_REPORT_FILE")
+        if report_file and _spill is None:
+            fatal = os.environ.get("PADDLE_WD_FATAL") == "1"
+            stop = threading.Event()
+            t = threading.Thread(target=_spill_loop,
+                                 args=(stop, report_file, fatal),
+                                 daemon=True, name="wd-spill")
+            t.start()
+            _spill = (t, stop)
         return True
 
 
 def disable():
-    global _wd
+    global _wd, _spill
+    with _lock:
+        spill, _spill = _spill, None
+        if spill is not None:
+            spill[1].set()
+    # join OUTSIDE the lock: the spill thread's drain_report needs the lock
+    if spill is not None:
+        spill[0].join(timeout=2)
     with _lock:
         if _wd is not None:
             lib, h = _wd
-            lib.watchdog_destroy(h)
             _wd = None
+            if spill is None or not spill[0].is_alive():
+                lib.watchdog_destroy(h)
+            # else: the spill thread is wedged (e.g. fsync on a hung mount);
+            # leak the native handle rather than free it under the thread
 
 
 @contextlib.contextmanager
 def comm_task(desc: str, timeout_seconds=None):
     """Track a blocking region; no-op when the watchdog is off."""
-    if _wd is None:
+    with _lock:
+        wd = _wd
+        if wd is None:
+            tid = None
+        else:
+            lib, h = wd
+            tid = lib.watchdog_register(h, desc.encode(),
+                                        int((timeout_seconds or 0) * 1000))
+    if tid is None:
         yield
         return
-    lib, h = _wd
-    tid = lib.watchdog_register(h, desc.encode(),
-                                int((timeout_seconds or 0) * 1000))
     try:
         yield
     finally:
-        lib.watchdog_complete(h, tid)
+        with _lock:
+            # a concurrent disable() may have destroyed the handle while
+            # this region ran — completing on it would be a use-after-free
+            if _wd is wd:
+                lib.watchdog_complete(h, tid)
 
 
 def drain_report() -> str:
-    if _wd is None:
-        return ""
-    lib, h = _wd
-    buf = ctypes.create_string_buffer(1 << 16)
-    n = lib.watchdog_drain_report(h, buf, len(buf))
+    # under _lock: disable() must not watchdog_destroy the handle while a
+    # reader (the spill thread in particular) is inside the native call
+    with _lock:
+        if _wd is None:
+            return ""
+        lib, h = _wd
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = lib.watchdog_drain_report(h, buf, len(buf))
     return buf.raw[:n].decode(errors="replace")
 
 
 def timeout_count() -> int:
-    if _wd is None:
-        return 0
-    lib, h = _wd
-    return int(lib.watchdog_timeout_count(h))
+    with _lock:
+        if _wd is None:
+            return 0
+        lib, h = _wd
+        return int(lib.watchdog_timeout_count(h))
 
 
 def inflight() -> int:
-    if _wd is None:
-        return 0
-    lib, h = _wd
-    return int(lib.watchdog_inflight(h))
+    with _lock:
+        if _wd is None:
+            return 0
+        lib, h = _wd
+        return int(lib.watchdog_inflight(h))

@@ -52,11 +52,46 @@ class ElasticManager:
         self.store = store
         self.enable = self.max_np > 1 or self.min_np != self.max_np
         self._stopped = False
+        # reform tracking: a formed job that loses a node (below min ⇒ HOLD,
+        # or a shrink within the runnable band) must make every survivor
+        # observe exactly one reform signal, so collective groups re-form
+        # around the new membership. The signal is a GENERATION COUNTER in
+        # the shared store (not a local flag): nodes whose polls never
+        # landed inside the shrink window still see the bumped generation.
+        # `last_restart_was_reform` distinguishes that signal from the
+        # steady "can still scale up" RESTART of a partial band, which a
+        # runnable cluster must NOT keep exiting on. Known limitation
+        # (docs/RESILIENCE.md): growth within the band (a node JOINING a
+        # runnable partial cluster) is a scale-out event for the launcher,
+        # not an in-step reform — matching the seed semantics where growth
+        # to full strength reads OK.
+        self._was_ready = False
+        self._bump_pending = False
+        self._last_alive = None
+        self._reform_gen_seen = None
+        self.last_restart_was_reform = False
 
     # ------------------------------------------------------------------ #
 
     def _key(self, rank):
         return f"{self.job_id}/heartbeat/{rank}"
+
+    def _reform_key(self):
+        return f"{self.job_id}/reform_gen"
+
+    def _reform_gen(self):
+        probe = getattr(self.store, "tryget", None)
+        try:
+            raw = probe(self._reform_key()) if probe else None
+            if not raw:
+                return 0
+            try:
+                return int(raw)  # decimal (fake stores)
+            except ValueError:
+                # native ADD stores 8-byte little-endian i64
+                return int.from_bytes(raw[:8], "little", signed=True)
+        except Exception:
+            return 0
 
     def heartbeat(self):
         """Publish this node's liveness (reference: etcd lease refresh)."""
@@ -107,15 +142,56 @@ class ElasticManager:
     def watch(self):
         """One scheduling decision (reference manager.watch loop):
         COMPLETED when training reported done, HOLD below min (wait for
-        rejoin), RESTART while the live set can still change, OK for a
-        healthy full cluster."""
+        rejoin), RESTART while the live set can still change OR right after
+        a hold ends (rejoin ⇒ re-form the groups), OK for a healthy full
+        cluster."""
+        self.last_restart_was_reform = False
         if self.is_completed():
             return ElasticStatus.COMPLETED
         alive = self.alive_nodes()
-        if len(alive) < self.min_np:
+        alive_set = frozenset(alive)
+        below = len(alive) < self.min_np
+
+        # detect an un-signaled departure: a formed job entering HOLD, or a
+        # shrink inside the runnable band. The pending flag is STICKY until
+        # the generation actually advances — advancing local state before a
+        # successful store.add() would lose the one-shot signal forever on
+        # a transient store error.
+        if below:
+            if self._was_ready:
+                self._bump_pending = True
+            self._was_ready = False
+            self._last_alive = None
+        else:
+            if self._last_alive is not None and self._last_alive - alive_set:
+                self._bump_pending = True
+            self._was_ready = True
+            self._last_alive = alive_set
+
+        # only the LOWEST surviving rank bumps for an event: all survivors
+        # observe the same departure, and N bumps for one event would read
+        # as N distinct reforms to late adopters
+        if self._bump_pending and alive and self.rank == min(alive):
+            try:
+                self.store.add(self._reform_key(), 1)
+                self._bump_pending = False
+            except Exception:
+                pass  # sticky: retried on the next poll
+
+        if below:
             return ElasticStatus.HOLD
-        if len(alive) < self.max_np:
+        gen = self._reform_gen()
+        if self._reform_gen_seen is None:
+            # first formation sighting by this process: its own groups are
+            # forming fresh anyway, nothing to re-form
+            self._reform_gen_seen = gen
+        elif gen > self._reform_gen_seen:
+            self._reform_gen_seen = gen
+            self._bump_pending = False  # signaled — by this node or a peer
+            self.last_restart_was_reform = True
             return ElasticStatus.RESTART
+        if len(alive) < self.max_np:
+            return ElasticStatus.RESTART  # can still scale up (steady state)
         return ElasticStatus.OK
 
     def exit(self, completed=True):

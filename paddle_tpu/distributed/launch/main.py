@@ -86,6 +86,7 @@ class Pod:
         self.restart_count = restart_count
         self.procs: list[subprocess.Popen] = []
         self.log_paths: list[str] = []
+        self.wd_report_paths: list[str] = []
 
     def spawn(self):
         args = self.args
@@ -105,6 +106,24 @@ class Pod:
             log_path = os.path.join(
                 args.log_dir, f"workerlog.{local}.r{self.restart_count}")
             self.log_paths.append(log_path)
+            # comm-watchdog post-mortem channel: the worker's spill thread
+            # appends timeout reports here (comm_watchdog.enable), and the
+            # launcher folds the file into the worker log on death so
+            # hang-induced restarts are diagnosable after the fact
+            wd_path = log_path + ".wd"
+            try:
+                # stale report from a previous launcher run in the same
+                # log_dir must not be pinned on this pod's death (the
+                # LogWatcher guards the .log channel the same way)
+                os.unlink(wd_path)
+            except OSError:
+                pass
+            env["PADDLE_WD_REPORT_FILE"] = wd_path
+            self.wd_report_paths.append(wd_path)
+            if args.max_restart > 0:
+                # restartable pods escalate hangs: the spill thread's
+                # FatalError line trips the LogWatcher → teardown → respawn
+                env["PADDLE_WD_FATAL"] = "1"
             logf = open(log_path, "ab")
             proc = subprocess.Popen(
                 [sys.executable, args.training_script,
@@ -137,6 +156,28 @@ class Pod:
             f = getattr(p, "_logf", None)
             if f is not None and not f.closed:
                 f.close()
+
+    def dump_watchdog_reports(self):
+        """Post-mortem: drain each worker's comm-watchdog spill file into its
+        log (and the launcher's stderr) before respawning, so the stuck-step
+        report survives the restart that destroys the worker process."""
+        for local, (log_path, wd_path) in enumerate(
+                zip(self.log_paths, self.wd_report_paths)):
+            try:
+                with open(wd_path) as f:
+                    report = f.read().strip()
+            except OSError:
+                continue
+            if not report:
+                continue
+            banner = (f"\n[launch] comm-watchdog post-mortem for worker "
+                      f"{local} (restart {self.restart_count}):\n{report}\n")
+            try:
+                with open(log_path, "a") as f:
+                    f.write(banner)
+            except OSError:
+                pass
+            print(banner, file=sys.stderr)
 
     def watch(self, fatal_evt=None):
         """Block until the pod finishes, a worker fails, or the log watcher
@@ -218,6 +259,8 @@ def launch():
         watcher.join(timeout=5)
         for line in watcher.fatal_lines:
             print(f"[launch] fatal log: {line}", file=sys.stderr)
+        if code != 0:
+            pod.dump_watchdog_reports()
         if code == 0:
             sys.exit(0)
         if restart >= args.max_restart:

@@ -1,0 +1,239 @@
+"""ResilientTrainer — the recovery story wired end to end.
+
+The survival organs already exist in isolation: the launcher's restart loop
+(`launch/main.py`, PADDLE_RESTART_COUNT), ElasticManager heartbeats/liveness
+(`fleet/elastic/manager.py`), the native comm watchdog
+(`comm_watchdog.comm_task`), and the crash-safe sharded checkpoint
+(`distributed/checkpoint/`). This module composes them into one driver:
+
+    def step_fn(step):
+        return train_step(x, y)          # one optimization step
+
+    trainer = ResilientTrainer(step_fn, state_dict, "ckpts",
+                               save_every=100, step_timeout=600)
+    trainer.run(num_steps)
+
+Per failure mode (docs/RESILIENCE.md):
+
+* **Worker death / preemption** (incl. mid-save): the launcher respawns the
+  pod; on entry `run()` restores from `latest_checkpoint`, which skips any
+  uncommitted/corrupt save. Resume is automatic — the step offset comes from
+  the checkpoint dir name, not from any state the dead process held.
+* **Hang** (stuck collective / wedged host sync): every step runs inside
+  `comm_watchdog.comm_task` with a deadline; the watchdog's monitor thread
+  spills its report to PADDLE_WD_REPORT_FILE and (under the launcher) emits
+  a FatalError line that the LogWatcher turns into a pod teardown + restart.
+* **Node loss below min_np**: the elastic manager reports HOLD; the trainer
+  pauses (keeps heartbeating) until the cluster refills or `hold_timeout`
+  expires, and honors the RESTART reform signal after a rejoin.
+* **Corrupt checkpoint on disk**: checksums reject it at restore and
+  discovery falls back to the previous committed step.
+
+Resume works across a changed (dp, mp) layout: `load_state_dict` reshards
+saved shards onto each tensor's CURRENT placement, so a pod that comes back
+with a different mesh factorization restores the same global state.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from . import comm_watchdog, faults
+from .checkpoint.manager import CheckpointManager
+
+__all__ = ["ResilientTrainer", "run_with_recovery", "REFORM_EXIT_CODE"]
+
+# a worker exits with this code to request an in-place pod re-form from the
+# launcher's restart loop (distinct from faults.FAULT_EXIT_CODE and from
+# ordinary crashes only for log readability — any nonzero code restarts)
+REFORM_EXIT_CODE = 75
+
+
+class ResilientTrainer:
+    """Drive `step_fn` for `num_steps` with periodic crash-safe checkpoints,
+    elastic liveness, watchdog deadlines, and auto-resume.
+
+    Parameters
+    ----------
+    step_fn : callable(step:int) -> loss
+        One optimization step. Must mutate the same tensors that
+        `state_dict` exposes (the usual TrainStep/optimizer contract).
+    state_dict : dict | callable() -> dict
+        name -> Tensor map covering model AND optimizer state; loaded in
+        place on resume (reshard-on-load handles a changed mesh). A callable
+        is re-evaluated at save/restore time for trainers that rebuild
+        state views.
+    ckpt_dir : str
+        Checkpoint root (step_N dirs are managed under it).
+    save_every : int
+        Commit a checkpoint every N steps (and once at the end).
+    keep_last_n : int
+        Checkpoint rotation depth.
+    async_save : bool
+        Double-buffered background saves (single-process runs).
+    elastic : ElasticManager | None
+        When given: heartbeat each step, pause on HOLD, and exit with
+        REFORM_EXIT_CODE on a reform signal if `exit_on_reform`.
+    step_timeout : float | None
+        Per-step watchdog deadline in seconds; enables the native comm
+        watchdog when set (no-op if the native lib is unavailable).
+    """
+
+    def __init__(self, step_fn, state_dict, ckpt_dir, *, save_every=100,
+                 keep_last_n=3, async_save=True, elastic=None,
+                 step_timeout=None, hold_poll=1.0, hold_timeout=300.0,
+                 exit_on_reform=False, log=None):
+        self.step_fn = step_fn
+        self._state_dict = state_dict
+        self.manager = CheckpointManager(ckpt_dir, keep_last_n=keep_last_n,
+                                         async_save=async_save)
+        self.save_every = max(1, int(save_every))
+        self.elastic = elastic
+        self.step_timeout = step_timeout
+        self.hold_poll = hold_poll
+        self.hold_timeout = hold_timeout
+        self.exit_on_reform = exit_on_reform
+        self.restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+        self.resumed_from = None
+        self._log = log or (lambda msg: print(f"[resilience] {msg}",
+                                              file=sys.stderr, flush=True))
+        self._timeouts_seen = 0
+
+    # ------------------------------------------------------------------ #
+
+    def state(self):
+        return self._state_dict() if callable(self._state_dict) \
+            else self._state_dict
+
+    def resume(self):
+        """Restore the newest valid checkpoint; returns the first step to
+        run (0 on a fresh start)."""
+        step = self.manager.restore_latest(self.state())
+        if step is None:
+            if self.restart_count > 0:
+                self._log(f"restart #{self.restart_count}: no valid "
+                          "checkpoint found, starting from step 0")
+            return 0
+        self.resumed_from = step
+        self._log(f"restart #{self.restart_count}: resumed from committed "
+                  f"step {step} ({self.manager.path_for(step)})")
+        return step + 1
+
+    # ------------------------------------------------------------------ #
+
+    def _wait_ready(self, step):
+        """Heartbeat + elastic gate: block while the cluster is below
+        min_np, honor the reform signal after a rejoin."""
+        if self.elastic is None:
+            return
+        from .fleet.elastic.manager import ElasticStatus
+
+        self.elastic.heartbeat()
+        status = self.elastic.watch()
+        if status == ElasticStatus.HOLD:
+            deadline = time.monotonic() + self.hold_timeout
+            self._log(f"step {step}: cluster below min_np, holding "
+                      f"(up to {self.hold_timeout}s)")
+            while status == ElasticStatus.HOLD:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"elastic hold timed out after {self.hold_timeout}s "
+                        "waiting for the cluster to refill")
+                time.sleep(self.hold_poll)
+                self.elastic.heartbeat()
+                status = self.elastic.watch()
+            self._log(f"step {step}: cluster refilled ({status})")
+        # exit only on a genuine reform signal (a node left, or the cluster
+        # refilled after a hold) — flagged by the manager's shared reform
+        # generation. A partial-but-runnable cluster reports RESTART
+        # steady-state as a scale-up hint; exiting on that would livelock:
+        # every respawned worker would exit at its first step without ever
+        # training.
+        if (status == ElasticStatus.RESTART and self.exit_on_reform
+                and getattr(self.elastic, "last_restart_was_reform", True)):
+            self._log(f"step {step}: membership changed — exiting for an "
+                      "in-place pod re-form")
+            self.manager.wait()
+            sys.exit(REFORM_EXIT_CODE)
+
+    def _check_watchdog(self, step):
+        n = comm_watchdog.timeout_count()
+        if n > self._timeouts_seen:
+            self._timeouts_seen = n
+            report = comm_watchdog.drain_report()
+            # the spill thread may have drained it to the report file first;
+            # either way the timeout itself is worth a log line
+            self._log(f"step {step}: comm watchdog flagged a deadline "
+                      f"overrun ({n} total)"
+                      + (f"\n{report}" if report else ""))
+
+    # ------------------------------------------------------------------ #
+
+    def _start_heartbeat_thread(self):
+        """Heartbeat on a cadence independent of step duration: a 15-minute
+        first-step compile or a multi-GB sync save must not age this node's
+        heartbeat past the liveness timeout and read as a death to peers."""
+        stop = threading.Event()
+        interval = getattr(self.elastic, "heartbeat_interval", 2.0)
+
+        def _beat():
+            while not stop.wait(interval):
+                try:
+                    self.elastic.heartbeat()
+                except Exception:
+                    pass  # store hiccup: the next beat retries
+
+        t = threading.Thread(target=_beat, daemon=True, name="elastic-hb")
+        t.start()
+        return stop
+
+    def run(self, num_steps):
+        """Train to `num_steps` total steps (counting completed pre-crash
+        progress); returns a summary dict."""
+        start = self.resume()
+        if self.step_timeout is not None:
+            comm_watchdog.enable()
+            # only report overruns from THIS run, not a previous trainer's
+            self._timeouts_seen = comm_watchdog.timeout_count()
+        hb_stop = None
+        if self.elastic is not None:
+            hb_stop = self._start_heartbeat_thread()
+        last_loss = None
+        saved_at = start - 1
+        step = start
+        try:
+            for step in range(start, num_steps):
+                self._wait_ready(step)
+                with comm_watchdog.comm_task(f"train_step/{step}",
+                                             self.step_timeout):
+                    # inside the watchdog region: an injected stall here is
+                    # exactly a step wedged in a collective
+                    faults.fault_point("trainer.before_step")
+                    last_loss = self.step_fn(step)
+                self._check_watchdog(step)
+                if (step + 1) % self.save_every == 0:
+                    self.manager.save(self.state(), step)
+                    saved_at = step
+            if num_steps > start and saved_at != num_steps - 1:
+                self.manager.save(self.state(), num_steps - 1)
+            self.manager.wait()
+        finally:
+            if hb_stop is not None:
+                hb_stop.set()
+        if self.elastic is not None:
+            self.elastic.exit(completed=True)
+        return {
+            "start_step": start,
+            "last_step": max(num_steps - 1, start - 1),
+            "resumed_from": self.resumed_from,
+            "restart_count": self.restart_count,
+            "last_loss": last_loss,
+        }
+
+
+def run_with_recovery(step_fn, state_dict, ckpt_dir, num_steps, **kwargs):
+    """Functional wrapper: build a ResilientTrainer and run it."""
+    return ResilientTrainer(step_fn, state_dict, ckpt_dir, **kwargs).run(num_steps)

@@ -11,11 +11,49 @@ barriers, and elastic bookkeeping.
 from __future__ import annotations
 
 import os
+import sys
 import threading
+import time
 
 from ..framework import native
 
 __all__ = ["TCPStore", "create_or_get_global_tcp_store"]
+
+
+def _connect_with_backoff(lib, host, port, timeout_ms, io_timeout_ms):
+    """Connect with bounded exponential backoff inside the overall timeout.
+
+    Workers racing the master's bind at pod start is THE common elastic
+    failure: on a restart every worker reconnects immediately while rank 0
+    is still re-binding the server socket, so the first attempts get
+    ECONNREFUSED and must retry, not die. Each attempt gets a FRESH socket:
+    the native connect loop reuses its fd across connect() calls, and POSIX
+    leaves a socket's state undefined after a failed connect — retrying on
+    the same fd can spin to the deadline without ever succeeding even once
+    the server is up. Returns (fd, attempts)."""
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    delay = 0.05
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining_ms = max(1, int((deadline - time.monotonic()) * 1000))
+        # early attempts are short (ECONNREFUSED returns instantly while the
+        # master hasn't bound yet); later attempts get 3s so a SYN dropped by
+        # a full listen backlog can ride out the ~1s kernel retransmit
+        per_attempt_ms = 500 if attempt <= 3 else 3000
+        fd = lib.tcp_store_connect(host.encode(), int(port),
+                                   min(remaining_ms, per_attempt_ms),
+                                   io_timeout_ms)
+        if fd >= 0:
+            return fd, attempt
+        if time.monotonic() + delay >= deadline:
+            return fd, attempt
+        if attempt == 1 or attempt % 8 == 0:
+            print(f"[tcp_store] connect to {host}:{port} refused "
+                  f"(attempt {attempt}), retrying for another "
+                  f"{deadline - time.monotonic():.1f}s", file=sys.stderr)
+        time.sleep(delay)
+        delay = min(delay * 2, 1.0)
 
 
 class TCPStore:
@@ -40,13 +78,17 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             port = lib.tcp_store_server_port(self._server)
         self._port = int(port)
-        self._fd = lib.tcp_store_connect(host.encode(), self._port,
-                                         self._timeout_ms,
-                                         int(io_timeout * 1000))
+        self._fd, attempts = _connect_with_backoff(
+            lib, host, self._port, self._timeout_ms, int(io_timeout * 1000))
         if self._fd < 0:
             if self._server:
                 lib.tcp_store_server_stop(self._server)
-            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+                # clear it: __del__→close() on this half-built instance
+                # would otherwise stop (and free) the server a second time
+                self._server = None
+            raise RuntimeError(
+                f"TCPStore: cannot connect to {host}:{port} after "
+                f"{attempts} attempt(s) over {self._timeout_ms / 1000:.0f}s")
         self._lock = threading.Lock()
 
     @property
@@ -136,18 +178,27 @@ class TCPStore:
         self.wait(f"{prefix}/done")
 
     def close(self):
-        if self._fd >= 0:
+        # getattr guards: __del__ reaches here for instances whose __init__
+        # raised before these attributes existed (e.g. failed bind)
+        if getattr(self, "_fd", -1) >= 0:
             self._lib.tcp_store_close(self._fd)
             self._fd = -1
-        if self._server:
+        if getattr(self, "_server", None):
             self._lib.tcp_store_server_stop(self._server)
             self._server = None
 
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception as e:
+            # never raise out of GC, but never swallow silently either — a
+            # failed close can leak the server socket and wedge the NEXT
+            # rendezvous on this port
+            try:
+                print(f"[tcp_store] warning: close failed during GC: {e!r}",
+                      file=sys.stderr)
+            except Exception:
+                pass  # interpreter teardown: stderr may already be gone
 
 
 _global_store = None

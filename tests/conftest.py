@@ -34,6 +34,39 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture
+def fault_injector(monkeypatch):
+    """Resilience fault harness (tools/fault_inject.py + distributed/faults):
+    arm in-process fault points via env, corrupt/truncate checkpoint files.
+
+        def test_x(fault_injector, tmp_path):
+            fault_injector.arm("ckpt.before_commit", "exc")   # or kill/sleep
+            fault_injector.corrupt(ckpt_dir)                  # flip bytes
+            fault_injector.truncate(ckpt_dir, frac=0.3)
+    """
+    from paddle_tpu.distributed import faults
+    from tools import fault_inject as fi
+
+    class _Injector:
+        def arm(self, point, action, arg=None, nth=None):
+            spec = f"{point}:{action}" + (f":{arg}" if arg is not None else "")
+            if nth is not None:
+                spec += f"@{nth}"
+            prev = os.environ.get("PADDLE_FAULT_INJECT", "")
+            faults.reset()  # fresh @n counters even for an identical spec
+            monkeypatch.setenv("PADDLE_FAULT_INJECT",
+                               f"{prev},{spec}" if prev else spec)
+
+        def disarm(self):
+            monkeypatch.delenv("PADDLE_FAULT_INJECT", raising=False)
+            faults.reset()
+
+        corrupt = staticmethod(fi.corrupt_file)
+        truncate = staticmethod(fi.truncate_file)
+
+    return _Injector()
+
+
+@pytest.fixture
 def pallas_interpret_unless_hw(monkeypatch):
     """Interpret-mode Pallas hides Mosaic layout bugs (round-2 finding); under
     PADDLE_TPU_HW=1 (tools/hw_session.sh) kernels must compile on the real
@@ -51,6 +84,14 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # hardware-validation session.
 if not _ON_HW:
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; slow marks the fault-injection tests that
+    # fork full worker pods and wait out real watchdog deadlines
+    config.addinivalue_line(
+        "markers", "slow: multi-process fault-injection/recovery tests "
+                   "excluded from tier-1 (`-m 'not slow'`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,538 @@
+"""Fault tolerance end to end: crash-safe checkpoint commit protocol,
+auto-resume, elastic status transitions, watchdog post-mortems, and the
+fault-injection harness itself (docs/RESILIENCE.md).
+
+The headline test is kill-during-save under the real launcher: a worker is
+SIGKILL'd (os._exit) between writing a checkpoint's metadata and its COMMIT
+marker, the pod respawns, and training resumes from the last committed step
+with no manual cleanup."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import comm_watchdog
+from paddle_tpu.distributed.checkpoint import (
+    COMMIT_FILE,
+    CheckpointCorruptError,
+    CheckpointManager,
+    Metadata,
+    latest_checkpoint,
+    load_state_dict,
+    validate_checkpoint,
+)
+from paddle_tpu.distributed.checkpoint.metadata import metadata_path
+from paddle_tpu.distributed.faults import FAULT_EXIT_CODE, FaultInjected
+from paddle_tpu.distributed.resilience import ResilientTrainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sd(val=0.0, n=6):
+    return {"w": paddle.to_tensor(np.full((n,), val, np.float32))}
+
+
+# --------------------------------------------------------------------------- #
+# commit protocol
+# --------------------------------------------------------------------------- #
+
+class TestCommitProtocol:
+    def test_commit_layout(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_sd(1.0), 7)
+        path = mgr.path_for(7)
+        assert os.path.isfile(os.path.join(path, COMMIT_FILE))
+        assert not os.path.isdir(path + ".tmp")
+        meta = Metadata.load(metadata_path(path))
+        assert meta.file_checksums  # file-level crc recorded
+        for entries in meta.state_dict_metadata.values():
+            assert all(m.checksum.startswith("crc32:") for m in entries)
+        ok, reason = validate_checkpoint(path)
+        assert ok, reason
+
+    def test_interrupted_save_is_skipped_and_swept(self, tmp_path,
+                                                   fault_injector):
+        """(a) save dies between metadata and COMMIT: discovery resumes from
+        the previous commit; the partial needs no manual cleanup."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_sd(1.0), 1)
+        mgr.save(_sd(2.0), 2)
+        fault_injector.arm("ckpt.before_commit", "exc")
+        with pytest.raises(FaultInjected):
+            mgr.save(_sd(3.0), 3)
+        fault_injector.disarm()
+        # the partial save left a .tmp (shards + metadata, no COMMIT)
+        assert os.path.isdir(mgr.path_for(3) + ".tmp")
+        assert not os.path.isdir(mgr.path_for(3))
+        info = latest_checkpoint(str(tmp_path))
+        assert info.step == 2
+        tgt = _sd(0.0)
+        load_state_dict(tgt, info.path)
+        assert float(tgt["w"].numpy()[0]) == 2.0
+        # next save sweeps the stale tmp as a side effect of rotation
+        mgr.save(_sd(4.0), 4)
+        assert not os.path.isdir(mgr.path_for(3) + ".tmp")
+        assert latest_checkpoint(str(tmp_path)).step == 4
+
+    def test_mid_save_failure_leaves_no_metadata(self, tmp_path,
+                                                 fault_injector):
+        mgr = CheckpointManager(str(tmp_path))
+        fault_injector.arm("ckpt.mid_save", "exc")
+        with pytest.raises(FaultInjected):
+            mgr.save(_sd(1.0), 1)
+        fault_injector.disarm()
+        assert latest_checkpoint(str(tmp_path)) is None
+
+    def test_checksum_mismatch_names_file(self, tmp_path, fault_injector):
+        """(b) a bit-flipped shard raises a clear error naming the file and
+        is never loaded silently."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_sd(1.0), 1)
+        mgr.save(_sd(2.0), 2)
+        bad = fault_injector.corrupt(mgr.path_for(2))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            load_state_dict(_sd(0.0), mgr.path_for(2))
+        assert os.path.basename(bad) in str(ei.value)
+        # discovery falls back past the corruption
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_truncated_shard_detected(self, tmp_path, fault_injector):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_sd(5.0), 1)
+        fault_injector.truncate(mgr.path_for(1), frac=0.3)
+        with pytest.raises(CheckpointCorruptError):
+            load_state_dict(_sd(0.0), mgr.path_for(1))
+        assert latest_checkpoint(str(tmp_path)) is None
+
+    def test_keep_last_n_rotation(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+        for s in range(1, 6):
+            mgr.save(_sd(float(s)), s)
+        steps = sorted(d for d in os.listdir(str(tmp_path)))
+        assert steps == ["step_4", "step_5"]
+
+    def test_async_save_snapshots_at_call_time(self, tmp_path):
+        """Double-buffered save: mutations after save() must not leak into
+        the checkpoint (device→host snapshot happens on the caller)."""
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        sd = _sd(3.0)
+        mgr.save(sd, 1)
+        sd["w"].set_value(paddle.to_tensor(np.full((6,), 99.0, np.float32)))
+        mgr.wait()
+        tgt = _sd(0.0)
+        assert mgr.restore_latest(tgt) == 1
+        assert float(tgt["w"].numpy()[0]) == 3.0
+
+    def test_async_failure_surfaces_on_wait(self, tmp_path, fault_injector):
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        fault_injector.arm("ckpt.before_commit", "exc")
+        mgr.save(_sd(1.0), 1)
+        with pytest.raises(FaultInjected):
+            mgr.wait()
+        fault_injector.disarm()
+        assert latest_checkpoint(str(tmp_path)) is None
+
+    def test_overwrite_preserves_unrelated_files(self, tmp_path):
+        """Re-saving into an existing checkpoint dir must not delete files a
+        user keeps alongside it (the pre-hardening save wrote in place)."""
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+
+        path = str(tmp_path / "ckpt")
+        save_state_dict(_sd(1.0), path)
+        keep = os.path.join(path, "notes.txt")
+        with open(keep, "w") as f:
+            f.write("user data")
+        save_state_dict(_sd(2.0), path)
+        assert open(keep).read() == "user data"
+        ok, reason = validate_checkpoint(path)
+        assert ok, reason
+        tgt = _sd(0.0)
+        load_state_dict(tgt, path)
+        assert float(tgt["w"].numpy()[0]) == 2.0
+
+    def test_legacy_checkpoint_without_checksums_loads(self, tmp_path):
+        """Pre-hardening checkpoints carry no checksums; they must still
+        load (nothing to verify against) rather than be rejected."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_sd(8.0), 1)
+        path = mgr.path_for(1)
+        meta = Metadata.load(metadata_path(path))
+        meta.file_checksums = {}
+        for entries in meta.state_dict_metadata.values():
+            for m in entries:
+                m.checksum = ""
+        meta.save(metadata_path(path))
+        tgt = _sd(0.0)
+        load_state_dict(tgt, path)
+        assert float(tgt["w"].numpy()[0]) == 8.0
+
+    def test_resume_under_different_sharding(self, tmp_path):
+        """Checkpoint written under one mesh config restores under another —
+        the reshard-on-load path the trainer relies on after an elastic
+        reconfiguration."""
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+        w = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        sd = {"w": dist.shard_tensor(paddle.to_tensor(w), mesh,
+                                     [dist.Shard(0), dist.Shard(1)])}
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(sd, 3)
+        mesh2 = dist.ProcessMesh(list(range(8)), dim_names=["p"])
+        tgt = {"w": dist.shard_tensor(paddle.to_tensor(np.zeros_like(w)),
+                                      mesh2, [dist.Shard(1)])}
+        assert mgr.restore_latest(tgt) == 3
+        np.testing.assert_allclose(tgt["w"].numpy(), w)
+
+
+# --------------------------------------------------------------------------- #
+# elastic transitions (fake store)
+# --------------------------------------------------------------------------- #
+
+class FakeStore:
+    """Dict-backed stand-in for the native TCPStore (tryget contract)."""
+
+    def __init__(self):
+        self.d = {}
+
+    def set(self, k, v):
+        self.d[k] = v if isinstance(v, bytes) else str(v).encode()
+
+    def tryget(self, k):
+        return self.d.get(k)
+
+    def add(self, k, amount):
+        cur = int(self.d.get(k, b"0")) + int(amount)
+        self.d[k] = str(cur).encode()
+        return cur
+
+    def delete_key(self, k):
+        self.d.pop(k, None)
+
+
+class TestElasticTransitions:
+    def test_ok_hold_restart_on_rejoin(self):
+        """(c) OK → HOLD on missed heartbeat → RESTART on rejoin → OK."""
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+
+        store = FakeStore()
+        m = ElasticManager(store=store, job_id="j", np_range="2:2", rank=0,
+                           timeout=5.0)
+        m.heartbeat()
+        store.set("j/heartbeat/1", str(time.time()))
+        assert m.watch() == ElasticStatus.OK
+        # rank 1 misses its heartbeat: below min_np → HOLD
+        store.set("j/heartbeat/1", str(time.time() - 60))
+        assert m.watch() == ElasticStatus.HOLD
+        assert m.watch() == ElasticStatus.HOLD  # stable while down
+        # rank 1 rejoins: one RESTART to re-form the groups, then OK
+        store.set("j/heartbeat/1", str(time.time()))
+        assert m.watch() == ElasticStatus.RESTART
+        assert m.watch() == ElasticStatus.OK
+
+    def test_initial_fillup_is_not_a_reform(self):
+        """Job start passes through HOLD while workers come up; reaching
+        full strength the first time is OK, not a membership change."""
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+
+        store = FakeStore()
+        m = ElasticManager(store=store, job_id="j2", np_range="2:2", rank=0,
+                           timeout=5.0)
+        assert m.watch() == ElasticStatus.HOLD
+        m.heartbeat()
+        store.set("j2/heartbeat/1", str(time.time()))
+        assert m.watch() == ElasticStatus.OK
+
+    def test_shrink_within_band_signals_one_reform(self):
+        """2:4 band: losing a node while still runnable must yield exactly
+        one reform-flagged RESTART per survivor; the steady partial band
+        keeps reporting plain (scale-up) RESTARTs that must NOT read as
+        reforms — exiting on those would livelock the trainer."""
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+
+        store = FakeStore()
+        m = ElasticManager(store=store, job_id="jb", np_range="2:4", rank=0,
+                           timeout=5.0)
+        m.heartbeat()
+        for r in (1, 2):
+            store.set(f"jb/heartbeat/{r}", str(time.time()))
+        assert m.watch() == ElasticStatus.RESTART  # 3/4: can still scale up
+        assert not m.last_restart_was_reform
+        assert m.watch() == ElasticStatus.RESTART  # steady state
+        assert not m.last_restart_was_reform
+        # node 2 dies; 2 alive >= min_np: survivors get ONE reform signal
+        store.set("jb/heartbeat/2", str(time.time() - 60))
+        assert m.watch() == ElasticStatus.RESTART
+        assert m.last_restart_was_reform
+        assert m.watch() == ElasticStatus.RESTART
+        assert not m.last_restart_was_reform
+
+    def test_completed_wins(self):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+
+        store = FakeStore()
+        m = ElasticManager(store=store, job_id="j3", np_range="1:1", rank=0)
+        m.heartbeat()
+        assert m.watch() == ElasticStatus.OK
+        m.complete()
+        assert m.watch() == ElasticStatus.COMPLETED
+
+
+# --------------------------------------------------------------------------- #
+# resilient trainer
+# --------------------------------------------------------------------------- #
+
+class TestResilientTrainer:
+    def test_resume_in_process(self, tmp_path, monkeypatch):
+        ckpt = str(tmp_path / "ck")
+        w = paddle.to_tensor(np.zeros(4, np.float32))
+
+        def step_fn(i):
+            w.set_value(paddle.to_tensor(w.numpy() + 1.0))
+            return float(w.numpy()[0])
+
+        out = ResilientTrainer(step_fn, {"w": w}, ckpt, save_every=2,
+                               async_save=False).run(5)
+        assert out["resumed_from"] is None and out["last_loss"] == 5.0
+
+        # "restart": fresh tensors, same checkpoint dir
+        monkeypatch.setenv("PADDLE_RESTART_COUNT", "1")
+        w2 = paddle.to_tensor(np.zeros(4, np.float32))
+        ran = []
+
+        def step_fn2(i):
+            ran.append(i)
+            w2.set_value(paddle.to_tensor(w2.numpy() + 1.0))
+            return float(w2.numpy()[0])
+
+        out2 = ResilientTrainer(step_fn2, {"w": w2}, ckpt, save_every=2,
+                                async_save=False).run(8)
+        assert out2["resumed_from"] == 4  # final save of run 1
+        assert ran == [5, 6, 7]
+        assert float(w2.numpy()[0]) == 8.0
+
+    def test_hold_times_out(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        m = ElasticManager(store=FakeStore(), job_id="jh", np_range="2:2",
+                           rank=0, timeout=5.0)
+        t = ResilientTrainer(lambda i: 0.0, _sd(), str(tmp_path), elastic=m,
+                             hold_poll=0.05, hold_timeout=0.3,
+                             async_save=False)
+        with pytest.raises(RuntimeError, match="hold timed out"):
+            t.run(1)
+
+    def test_watchdog_stall_spills_report(self, tmp_path):
+        """A step stalled past its comm_task deadline lands in the spill
+        file (the post-mortem the launcher dumps on worker death)."""
+        report_file = str(tmp_path / "wd.report")
+        comm_watchdog.disable()
+        assert comm_watchdog.enable(timeout_seconds=5.0,
+                                    report_file=report_file)
+        try:
+            with comm_watchdog.comm_task("stalled_step/3", 0.15):
+                time.sleep(0.5)
+            assert comm_watchdog.timeout_count() >= 1
+            deadline = time.time() + 3
+            content = ""
+            while time.time() < deadline and "stalled_step/3" not in content:
+                if os.path.exists(report_file):
+                    content = open(report_file).read()
+                time.sleep(0.05)
+            assert "stalled_step/3" in content
+            assert "exceeded" in content
+        finally:
+            comm_watchdog.disable()
+
+
+# --------------------------------------------------------------------------- #
+# launcher integration (forked workers)
+# --------------------------------------------------------------------------- #
+
+def _run_launch(tmp_path, extra_args, script_body, extra_env=None,
+                timeout=240):
+    script = os.path.join(str(tmp_path), "train.py")
+    with open(script, "w") as f:
+        f.write(script_body)
+    env = {
+        "PYTHONPATH": REPO,
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": "cpu",
+        "CKPT_DIR": os.path.join(str(tmp_path), "ckpts"),
+    }
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         f"--log_dir={tmp_path}/log", *extra_args, script],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=str(tmp_path))
+    return proc
+
+
+RESILIENT_TRAIN = """
+import os, sys
+import numpy as np
+restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+if restart == 0:
+    # fault only on the first life: die on the SECOND checkpoint, after
+    # metadata is written but before the COMMIT marker
+    os.environ["PADDLE_FAULT_INJECT"] = "ckpt.before_commit:kill@2"
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import ResilientTrainer
+
+w = paddle.to_tensor(np.zeros(4, np.float32))
+sd = {"w": w}
+def step_fn(i):
+    w.set_value(paddle.to_tensor(w.numpy() + 1.0))
+    return float(w.numpy()[0])
+t = ResilientTrainer(step_fn, sd, os.environ["CKPT_DIR"], save_every=2,
+                     async_save=False)
+out = t.run(6)
+print("RESUMED_FROM", out["resumed_from"], flush=True)
+print("FINAL", float(w.numpy()[0]), flush=True)
+"""
+
+
+def test_kill_during_save_resumes_from_last_commit(tmp_path):
+    """ACCEPTANCE: worker SIGKILL'd mid-save (metadata written, COMMIT
+    absent) → launcher respawns → training auto-resumes from the last
+    committed step, with no manual cleanup of the torn checkpoint."""
+    proc = _run_launch(tmp_path, ["--max_restart=2"], RESILIENT_TRAIN)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    # first life died with the injected fault's exit code
+    assert f"pod failed (exit {FAULT_EXIT_CODE})" in proc.stderr
+    logs = os.listdir(os.path.join(str(tmp_path), "log"))
+    r1 = [l for l in logs if l.endswith(".r1")][0]
+    out = open(os.path.join(str(tmp_path), "log", r1)).read()
+    # run 1 committed step 1 (w=2), died committing step 3; run 2 resumed
+    # from step 1 and trained steps 2..5 → w = 6
+    assert "RESUMED_FROM 1" in out, out
+    assert "FINAL 6.0" in out, out
+    # the torn save was swept by the resumed run's own rotation
+    ckpts = sorted(os.listdir(os.path.join(str(tmp_path), "ckpts")))
+    assert all(not d.endswith(".tmp") for d in ckpts), ckpts
+    info = latest_checkpoint(os.path.join(str(tmp_path), "ckpts"))
+    assert info is not None and info.step == 5
+
+
+def test_launcher_dumps_watchdog_report(tmp_path):
+    """On worker death the launcher folds the comm-watchdog spill file into
+    the worker log and its own stderr (post-mortem for hang restarts)."""
+    proc = _run_launch(tmp_path, [], """
+import os, sys
+with open(os.environ["PADDLE_WD_REPORT_FILE"], "w") as f:
+    f.write("[watchdog] task 1 'train_step/7' exceeded 500ms (9000ms elapsed)\\n")
+sys.exit(3)
+""")
+    assert proc.returncode == 3
+    assert "comm-watchdog post-mortem for worker 0" in proc.stderr
+    assert "train_step/7" in proc.stderr
+    log0 = open(os.path.join(str(tmp_path), "log", "workerlog.0.r0")).read()
+    assert "comm-watchdog post-mortem" in log0
+
+
+@pytest.mark.slow
+def test_hang_recovery_end_to_end(tmp_path):
+    """Forks real workers: a step wedges past the watchdog deadline, the
+    spill thread's FatalError line trips the launcher's LogWatcher, the pod
+    is torn down and respawned, and training resumes from the last commit."""
+    proc = _run_launch(tmp_path, ["--max_restart=2"], """
+import os, sys
+import numpy as np
+restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+if restart == 0:
+    # 4th step (step index 3, after the step-1 commit) hangs for 120s
+    os.environ["PADDLE_FAULT_INJECT"] = "trainer.before_step:sleep:120@4"
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import ResilientTrainer
+
+w = paddle.to_tensor(np.zeros(4, np.float32))
+def step_fn(i):
+    w.set_value(paddle.to_tensor(w.numpy() + 1.0))
+    return float(w.numpy()[0])
+t = ResilientTrainer(step_fn, {"w": w}, os.environ["CKPT_DIR"], save_every=2,
+                     async_save=False, step_timeout=1.0)
+out = t.run(6)
+print("RESUMED_FROM", out["resumed_from"], flush=True)
+print("FINAL", float(w.numpy()[0]), flush=True)
+""", timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "comm-watchdog post-mortem" in proc.stderr
+    logs = os.listdir(os.path.join(str(tmp_path), "log"))
+    r1 = [l for l in logs if l.endswith(".r1") and not l.endswith(".wd")][0]
+    out = open(os.path.join(str(tmp_path), "log", r1)).read()
+    assert "RESUMED_FROM 1" in out, out
+    assert "FINAL 6.0" in out, out
+
+
+# --------------------------------------------------------------------------- #
+# store backoff + harness self-test
+# --------------------------------------------------------------------------- #
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_store_connect_backoff_rescues_late_bind():
+    """Workers racing the master's bind at pod (re)start: the client retries
+    with backoff until the server appears instead of dying on the first
+    ECONNREFUSED."""
+    import threading
+
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()
+    server_holder = {}
+
+    def bind_late():
+        time.sleep(0.7)
+        server_holder["srv"] = TCPStore("127.0.0.1", port, is_master=True)
+
+    t = threading.Thread(target=bind_late, daemon=True)
+    t.start()
+    client = TCPStore("127.0.0.1", port, is_master=False, timeout=10)
+    try:
+        client.set("k", b"v")
+        assert client.get("k") == b"v"
+    finally:
+        client.close()
+        t.join()
+        server_holder["srv"].close()
+
+
+def test_store_connect_gives_up_after_deadline():
+    from paddle_tpu.distributed.store import TCPStore
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="attempt"):
+        TCPStore("127.0.0.1", _free_port(), is_master=False, timeout=0.8)
+    assert time.monotonic() - t0 < 10
+
+
+def test_fault_inject_cli_self_test(tmp_path):
+    """The harness verifies its own corruption round-trip
+    (`tools/fault_inject.py --self-test`)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fault_inject.py"),
+         "--self-test"],
+        env={"PYTHONPATH": REPO, "PATH": os.environ.get("PATH", ""),
+             "HOME": os.environ.get("HOME", "/root"),
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=180, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "self-test passed" in proc.stdout

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Fault-injection harness for the resilience stack (docs/RESILIENCE.md).
+
+Two layers:
+
+1. **On-disk faults** (usable against any checkpoint, no framework import):
+   `corrupt_file` flips bytes mid-file, `truncate_file` cuts it short —
+   simulating bit rot and torn writes respectively.
+
+2. **In-process fault points** (paddle_tpu/distributed/faults.py): arm via
+   PADDLE_FAULT_INJECT="point:action[:arg][@n]" to kill/raise/stall at the
+   exact instants a real failure lands:
+
+       ckpt.before_shards    save started, nothing written
+       ckpt.mid_save         shards on disk, no metadata
+       ckpt.before_commit    metadata written, no COMMIT marker
+       ckpt.before_rename    committed tmp dir, not yet visible
+       trainer.before_step   start of a train step (sleep => watchdog hang)
+
+CLI:
+    python tools/fault_inject.py --corrupt  CKPT_DIR_OR_FILE [--nbytes 8]
+    python tools/fault_inject.py --truncate CKPT_DIR_OR_FILE [--frac 0.5]
+    python tools/fault_inject.py --self-test       # harness verifies itself
+    python tools/fault_inject.py --list-points
+
+The pytest fixture `fault_injector` (tests/conftest.py) wraps all of this
+for tests. `--self-test` runs the corruption round-trip end to end (save →
+corrupt → checksum rejection → fallback; interrupted save → discovery skips
+the partial) so the harness itself is exercised, not assumed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import random
+import sys
+
+POINTS = [
+    ("ckpt.before_shards", "save started, nothing written yet"),
+    ("ckpt.mid_save", "shard files on disk, metadata absent"),
+    ("ckpt.before_commit", "metadata written, COMMIT marker absent"),
+    ("ckpt.before_rename", "committed tmp dir, final rename pending"),
+    ("trainer.before_step", "inside a ResilientTrainer step's watchdog region"),
+]
+
+
+def _pick_shard(target):
+    """A .distcp path: the file itself, or one inside a checkpoint dir."""
+    if os.path.isdir(target):
+        shards = sorted(glob.glob(os.path.join(target, "*.distcp")))
+        if not shards:
+            raise FileNotFoundError(f"no .distcp shard files under {target}")
+        return shards[0]
+    return target
+
+
+def corrupt_file(target, nbytes=8, seed=0):
+    """Flip `nbytes` random bytes mid-file (bit rot / bad DMA). Returns the
+    path actually corrupted."""
+    path = _pick_shard(target)
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        raise ValueError(f"{path} is empty, nothing to corrupt")
+    rng = random.Random(seed)
+    for _ in range(max(1, nbytes)):
+        i = rng.randrange(len(data))
+        data[i] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return path
+
+
+def truncate_file(target, frac=0.5):
+    """Cut the file to `frac` of its size (torn write / dead host mid-flush).
+    Returns the path actually truncated."""
+    path = _pick_shard(target)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, int(size * frac)))
+    return path
+
+
+# --------------------------------------------------------------------------- #
+# self-test: the harness proving it can make the checkpoint layer fail
+# --------------------------------------------------------------------------- #
+
+def self_test():
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import faults
+    from paddle_tpu.distributed.checkpoint import (
+        CheckpointCorruptError,
+        CheckpointManager,
+        latest_checkpoint,
+        load_state_dict,
+    )
+
+    failures = []
+
+    def check(name, cond):
+        print(f"  [{'ok' if cond else 'FAIL'}] {name}")
+        if not cond:
+            failures.append(name)
+
+    root = tempfile.mkdtemp(prefix="fi_selftest_")
+    mgr = CheckpointManager(root, keep_last_n=4)
+    sd = {"w": paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))}
+    mgr.save(sd, 1)
+    mgr.save(sd, 2)
+    check("two committed checkpoints", latest_checkpoint(root).step == 2)
+
+    # corruption round-trip: flip bytes -> load raises naming the file,
+    # discovery falls back to step 1
+    bad = corrupt_file(mgr.path_for(2))
+    try:
+        load_state_dict({"w": paddle.to_tensor(np.zeros((4, 6), np.float32))},
+                        mgr.path_for(2))
+        check("corrupt load raises", False)
+    except CheckpointCorruptError as e:
+        check("corrupt load raises naming the file",
+              os.path.basename(bad) in str(e))
+    check("discovery falls back past corruption",
+          latest_checkpoint(root).step == 1)
+
+    # truncation round-trip
+    mgr.save(sd, 3)
+    truncate_file(mgr.path_for(3), frac=0.3)
+    try:
+        load_state_dict({"w": paddle.to_tensor(np.zeros((4, 6), np.float32))},
+                        mgr.path_for(3))
+        check("truncated load raises", False)
+    except CheckpointCorruptError:
+        check("truncated load raises", True)
+    check("discovery falls back past truncation",
+          latest_checkpoint(root).step == 1)
+
+    # interrupted save (in-process exc at the commit boundary): tmp dir
+    # left behind, discovery ignores it, next save sweeps it
+    os.environ["PADDLE_FAULT_INJECT"] = "ckpt.before_commit:exc"
+    try:
+        try:
+            mgr.save(sd, 4)
+            check("armed fault point trips", False)
+        except faults.FaultInjected:
+            check("armed fault point trips", True)
+    finally:
+        del os.environ["PADDLE_FAULT_INJECT"]
+    check("interrupted save leaves only a .tmp",
+          not os.path.isdir(mgr.path_for(4))
+          and os.path.isdir(mgr.path_for(4) + ".tmp"))
+    check("discovery ignores the partial save",
+          latest_checkpoint(root).step == 1)
+    mgr.save(sd, 5)
+    check("next save sweeps the stale .tmp",
+          not os.path.isdir(mgr.path_for(4) + ".tmp"))
+    check("recovery proceeds past it", latest_checkpoint(root).step == 5)
+
+    if failures:
+        print(f"self-test FAILED: {failures}")
+        return 1
+    print("self-test passed")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--corrupt", metavar="PATH",
+                   help="flip bytes in a shard file (or first shard of a dir)")
+    p.add_argument("--truncate", metavar="PATH",
+                   help="truncate a shard file (or first shard of a dir)")
+    p.add_argument("--nbytes", type=int, default=8)
+    p.add_argument("--frac", type=float, default=0.5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--list-points", action="store_true")
+    p.add_argument("--self-test", action="store_true",
+                   help="verify the harness against the checkpoint layer")
+    args = p.parse_args(argv)
+    if args.list_points:
+        for name, desc in POINTS:
+            print(f"{name:24s} {desc}")
+        return 0
+    if args.self_test:
+        return self_test()
+    if args.corrupt:
+        print(f"corrupted: {corrupt_file(args.corrupt, args.nbytes, args.seed)}")
+        return 0
+    if args.truncate:
+        print(f"truncated: {truncate_file(args.truncate, args.frac)}")
+        return 0
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
