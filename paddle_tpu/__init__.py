@@ -167,3 +167,31 @@ class version:  # noqa: N801 — reference paddle.version module shape
 
 
 __version__ = version.full_version
+
+
+def _maybe_install_graftlint_runtime():
+    """GRAFTLINT_RUNTIME=1 (raise) / =warn: enforce no-host-sync-under-trace
+    at runtime via the sync-observer hook — the dynamic cross-check for the
+    static GL001 rule (tools/graftlint, docs/LINTING.md)."""
+    import os as _os
+
+    # "0"/"false"/"off" must mean OFF (the conventional env idiom), not
+    # "truthy string → strict raise mode"
+    if _os.environ.get("GRAFTLINT_RUNTIME", "").strip().lower() in (
+            "", "0", "false", "off", "no"):
+        return
+    try:
+        from tools.graftlint import runtime as _glrt
+    except ImportError:
+        # installed without the repo's tools/ tree alongside — the static
+        # linter is a dev-time tool, its absence must not break the package
+        import warnings as _warnings
+
+        _warnings.warn(
+            "GRAFTLINT_RUNTIME is set but tools.graftlint is not importable; "
+            "runtime host-sync checks disabled", RuntimeWarning)
+        return
+    _glrt.install_runtime_checks()
+
+
+_maybe_install_graftlint_runtime()

@@ -227,10 +227,26 @@ class CheckpointManager:
                 print(f"[checkpoint] skipping {path}: {reason}",
                       file=sys.stderr)
                 continue
+            # the load mutates tensors in place one-by-one; a corruption hit
+            # on a LATER shard file must not leave a half-restored mix of
+            # checkpoint and live values behind the fallback (or behind the
+            # final "no valid checkpoint" fresh-start report). jax arrays are
+            # immutable, so snapshotting is reference-holding, not copying.
+            snapshot = [(k, v, getattr(v, "_value", None))
+                        for k, v in state_dict.items()]
             try:
                 load_state_dict(state_dict, path)
                 return step
-            except CheckpointCorruptError as e:
+            except BaseException as e:
+                # roll back on ANY mid-load failure — a KeyError (key absent
+                # from this checkpoint) or a KeyboardInterrupt leaves the
+                # same half-mutated mix corruption does
+                for k, v, val in snapshot:
+                    if val is not None:
+                        v._value = val
+                    state_dict[k] = v
+                if not isinstance(e, CheckpointCorruptError):
+                    raise
                 print(f"[checkpoint] skipping {path}: {e}", file=sys.stderr)
         return None
 

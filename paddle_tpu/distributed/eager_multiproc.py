@@ -41,7 +41,9 @@ def _jax():
 def nprocs() -> int:
     try:
         return _jax().process_count()
-    except Exception:
+    except (RuntimeError, ValueError, AttributeError):
+        # no distributed backend initialized (or a jax too old to have the
+        # query): by definition a single-controller process
         return 1
 
 
@@ -91,8 +93,8 @@ def cleanup_group_keys(store, gid=None):
             if s >= 0:
                 try:
                     store.delete_key(f"gar/{tag}/{s}/{me}")
-                except Exception:
-                    pass
+                except (KeyError, OSError, RuntimeError):
+                    pass  # already deleted by a peer's sweep / store gone
         _group_seq.pop(tag, None)
 
 
@@ -121,8 +123,8 @@ def store_allreduce_group(store, v, ranks, op="sum", gid=None):
     if seq >= 2:
         try:
             store.delete_key(f"gar/{tag}/{seq - 2}/{me}")
-        except Exception:
-            pass
+        except (KeyError, OSError, RuntimeError):
+            pass  # rolling cleanup is best-effort; reduction already done
     return _reduce_rows(vals, op)
 
 
@@ -187,6 +189,6 @@ def p2p_recv(store, src, dst):
     # consume: long-running send/recv loops must not grow the store
     try:
         store.delete_key(key)
-    except Exception:
-        pass
+    except (KeyError, OSError, RuntimeError):
+        pass  # value already read; a leaked key only costs store memory
     return out

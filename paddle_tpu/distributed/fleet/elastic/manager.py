@@ -90,7 +90,9 @@ class ElasticManager:
             except ValueError:
                 # native ADD stores 8-byte little-endian i64
                 return int.from_bytes(raw[:8], "little", signed=True)
-        except Exception:
+        except (OSError, RuntimeError, ConnectionError):
+            # store unreachable mid-poll: treat as "no reform signal yet";
+            # the next watch() tick re-probes
             return 0
 
     def heartbeat(self):
@@ -111,8 +113,8 @@ class ElasticManager:
         for r in range(self.max_np):
             try:
                 raw = probe(self._key(r))
-            except Exception:
-                continue
+            except (OSError, RuntimeError, ConnectionError):
+                continue  # unreadable heartbeat == not provably alive
             if not raw:
                 continue
             try:
@@ -136,8 +138,8 @@ class ElasticManager:
         probe = getattr(self.store, "tryget", None)
         try:
             return bool(probe and probe(f"{self.job_id}/completed"))
-        except Exception:
-            return False
+        except (OSError, RuntimeError, ConnectionError):
+            return False  # store down != job done; keep polling
 
     def watch(self):
         """One scheduling decision (reference manager.watch loop):
@@ -175,8 +177,8 @@ class ElasticManager:
             try:
                 self.store.add(self._reform_key(), 1)
                 self._bump_pending = False
-            except Exception:
-                pass  # sticky: retried on the next poll
+            except Exception:  # graftlint: disable=GL003 sticky by design: the pending flag survives and the bump is retried on the next poll
+                pass
 
         if below:
             return ElasticStatus.HOLD
@@ -199,9 +201,9 @@ class ElasticManager:
         if completed:
             try:
                 self.complete()
-            except Exception:
+            except Exception:  # graftlint: disable=GL003 exit path: the store may already be torn down
                 pass
         try:
             self.store.delete_key(self._key(self.rank))
-        except Exception:
+        except Exception:  # graftlint: disable=GL003 exit path: a leaked heartbeat key just ages out
             pass

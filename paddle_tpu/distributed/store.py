@@ -197,8 +197,8 @@ class TCPStore:
             try:
                 print(f"[tcp_store] warning: close failed during GC: {e!r}",
                       file=sys.stderr)
-            except Exception:
-                pass  # interpreter teardown: stderr may already be gone
+            except Exception:  # graftlint: disable=GL003 interpreter teardown: stderr may already be gone
+                pass
 
 
 _global_store = None

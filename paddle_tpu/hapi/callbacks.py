@@ -318,7 +318,13 @@ class VisualDL(Callback):
             if isinstance(v, (list, tuple)):
                 v = v[0] if v else None
             if not isinstance(v, (int, float, np.floating, np.integer)):
-                continue
+                # the fit loop hands out the 0-d device loss between log
+                # points (no free per-step sync); a recorder callback is an
+                # explicit opt-in to per-step values, so it pays the read
+                if np.ndim(v) == 0 and hasattr(v, "__float__"):
+                    v = float(v)
+                else:
+                    continue
             if self._writer is not None:  # pragma: no cover
                 self._writer.add_scalar(f"{phase}/{k}", float(v), step)
             else:

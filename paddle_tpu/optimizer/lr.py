@@ -215,9 +215,19 @@ class ReduceOnPlateau(LRScheduler):
     def step(self, metrics=None, epoch=None):
         if metrics is None:
             return
-        from ..framework.core import Tensor
+        from ..framework.core import Tensor, in_tracing
 
-        cur = float(metrics.numpy()) if isinstance(metrics, Tensor) else float(metrics)
+        if isinstance(metrics, Tensor) and in_tracing():
+            # data-dependent LR control flow cannot live inside a compiled
+            # step: the metric is a tracer with no concrete value. Matching
+            # graftlint GL001 — fail loudly instead of silently retracing.
+            raise RuntimeError(
+                "ReduceOnPlateau.step() needs a concrete metric and cannot "
+                "run under jax tracing; call it from the host loop (e.g. at "
+                "epoch end) on the synced loss")
+        # single host sync via __float__ (the old metrics.numpy() round-trip
+        # materialized the full array first); epoch-boundary cost only
+        cur = float(metrics)
         self.last_epoch += 1
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1

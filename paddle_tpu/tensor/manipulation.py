@@ -624,7 +624,10 @@ def atleast_3d(*inputs, name=None):
 
 
 def tolist(x):
-    return _t(x).tolist()
+    # registered as the Tensor method below, so it must not dispatch back
+    # through `.tolist()` (infinite recursion — found by the graftlint
+    # runtime suite); .numpy() keeps the host-sync observer in the loop
+    return _t(x).numpy().tolist()
 
 
 def crop(x, shape=None, offsets=None, name=None):

@@ -1,0 +1,839 @@
+"""graftlint tier-1 suite: rule fixtures (true positives AND false-positive
+guards for each of GL001-GL005), suppression comments, baseline round-trip,
+CLI exit codes / --stats, the self-lint of paddle_tpu against the checked-in
+baseline, and the runtime cross-check proving GL001's static reachability
+matches what the sync-observer hook actually sees under tracing."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+from tools.graftlint import baseline as baseline_mod  # noqa: E402
+from tools.graftlint import lint_paths  # noqa: E402
+from tools.graftlint.__main__ import main as cli_main  # noqa: E402
+from tools.graftlint.rules import RULES  # noqa: E402
+
+
+def lint_src(tmp_path, src, rules=None, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return lint_paths([p], root=tmp_path, rules=rules)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------------------- #
+# GL001 host-sync-in-trace
+# --------------------------------------------------------------------------- #
+
+
+class TestGL001:
+    def test_numpy_and_cast_in_jitted_fn(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x.numpy())
+        """, rules=["GL001"])
+        assert len(fs) == 2  # float() cast + .numpy() sync
+        assert all(f.rule == "GL001" for f in fs)
+
+    def test_if_on_traced_param(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                if x:
+                    return x + 1
+                return x
+        """, rules=["GL001"])
+        assert rule_ids(fs) == ["GL001"]
+        assert "if x:" in fs[0].message
+
+    def test_transitive_callee_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def helper(t):
+                return t.item()
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """, rules=["GL001"])
+        assert rule_ids(fs) == ["GL001"]
+        assert ".item()" in fs[0].message
+
+    def test_transform_arg_and_guard_body(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+            from paddle_tpu.framework.core import tracing_guard
+
+            def loss_fn(t):
+                return t.tolist()
+
+            g = jax.grad(loss_fn)
+
+            def replay(fn, t):
+                with tracing_guard(True):
+                    return int(t)
+        """, rules=["GL001"])
+        msgs = " | ".join(f.message for f in fs)
+        assert len(fs) == 2
+        assert ".tolist()" in msgs and "int()" in msgs
+
+    def test_eager_code_not_flagged(self, tmp_path):
+        # the same syncs OUTSIDE any traced region are legitimate
+        fs = lint_src(tmp_path, """
+            def log_loss(t):
+                return float(t.numpy())
+
+            def fetch(t):
+                if t:
+                    return t.item()
+        """, rules=["GL001"])
+        assert fs == []
+
+    def test_safe_casts_and_python_flags_not_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def helper(x, training):
+                # Python config flag of a transitively-traced helper: a
+                # static branch, not a tracer bool
+                if training:
+                    x = x * 2
+                return float(len([x]))
+
+            @jax.jit
+            def step(x):
+                return helper(x, True)
+        """, rules=["GL001"])
+        assert fs == []
+
+    def test_cross_file_calls_not_followed(self, tmp_path):
+        # call-graph edges are per-file by design (see rule rationale)
+        (tmp_path / "helpers.py").write_text(textwrap.dedent("""
+            def helper(t):
+                return t.numpy()
+        """))
+        fs = lint_src(tmp_path, """
+            import jax
+            from helpers import helper
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """, rules=["GL001"])
+        assert fs == []
+
+    def test_fixed_hot_path_sites_stay_clean(self):
+        # regression for the .numpy() hot-path audit: the hapi fit loop and
+        # the LR schedulers must stay free of traced host syncs
+        fs = lint_paths(
+            [REPO / "paddle_tpu/hapi/model.py",
+             REPO / "paddle_tpu/optimizer/lr.py"],
+            root=REPO, rules=["GL001"])
+        assert fs == []
+
+    def test_old_hapi_pattern_would_be_flagged(self, tmp_path):
+        # the pre-audit idiom — per-step float(loss.numpy()) — placed where
+        # it would run under trace is exactly what GL001 exists to stop
+        fs = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def train_batch(loss):
+                return [float(loss.numpy())]
+        """, rules=["GL001"])
+        assert len(fs) == 2
+
+
+# --------------------------------------------------------------------------- #
+# GL002 rank-conditional collective
+# --------------------------------------------------------------------------- #
+
+
+class TestGL002:
+    def test_collective_under_rank_if(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import paddle_tpu.distributed as dist
+
+            def sync(rank, t):
+                if rank == 0:
+                    dist.all_reduce(t)
+        """, rules=["GL002"])
+        assert rule_ids(fs) == ["GL002"]
+        assert "all_reduce" in fs[0].message
+
+    def test_else_branch_and_get_rank_call(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import paddle_tpu.distributed as dist
+
+            def sync(t):
+                if dist.get_rank() == 0:
+                    pass
+                else:
+                    dist.broadcast(t, src=0)
+        """, rules=["GL002"])
+        assert rule_ids(fs) == ["GL002"]
+
+    def test_unconditional_collective_ok(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import paddle_tpu.distributed as dist
+
+            def sync(rank, t):
+                dist.all_reduce(t)
+                if rank == 0:
+                    print("rank0 saw", t.shape)
+        """, rules=["GL002"])
+        assert fs == []
+
+    def test_p2p_and_stdlib_reduce_ok(self, tmp_path):
+        # send/recv are legitimately rank-conditional; bare `reduce` is
+        # functools, not a collective
+        fs = lint_src(tmp_path, """
+            from functools import reduce
+            import paddle_tpu.distributed as dist
+
+            def route(rank, t, xs):
+                if rank == 0:
+                    dist.send(t, dst=1)
+                    return reduce(lambda a, b: a + b, xs)
+                dist.recv(t, src=0)
+        """, rules=["GL002"])
+        assert fs == []
+
+    def test_nested_rank_if_reported_once(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import paddle_tpu.distributed as dist
+
+            def sync(rank, t):
+                if rank < 4:
+                    if rank == 0:
+                        dist.all_reduce(t)
+        """, rules=["GL002"])
+        assert len(fs) == 1
+
+
+# --------------------------------------------------------------------------- #
+# GL003 swallowed exception
+# --------------------------------------------------------------------------- #
+
+
+class TestGL003:
+    def test_pass_and_continue_bodies_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            def probe(store, keys):
+                try:
+                    store.get("k")
+                except Exception:
+                    pass
+                for k in keys:
+                    try:
+                        store.get(k)
+                    except:
+                        continue
+        """, rules=["GL003"])
+        assert rule_ids(fs) == ["GL003", "GL003"]
+        assert "bare `except:`" in fs[1].message
+
+    def test_logging_narrow_raise_ok(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            def probe(store, log):
+                try:
+                    store.get("a")
+                except KeyError:
+                    pass
+                try:
+                    store.get("b")
+                except Exception as e:
+                    log.warning("probe failed: %r", e)
+                try:
+                    store.get("c")
+                except Exception:
+                    raise RuntimeError("store gone")
+        """, rules=["GL003"])
+        assert fs == []
+
+    def test_del_allowlisted(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            class Holder:
+                def __del__(self):
+                    try:
+                        self.close()
+                    except Exception:
+                        pass
+        """, rules=["GL003"])
+        assert fs == []
+
+    def test_distributed_layer_fixed_sites(self):
+        # the PR-1 leftovers named in the issue: now narrowed, logging, or
+        # carrying an explicit in-source disable — zero raw findings
+        fs = lint_paths(
+            [REPO / "paddle_tpu/distributed/eager_multiproc.py",
+             REPO / "paddle_tpu/distributed/store.py",
+             REPO / "paddle_tpu/distributed/fleet/elastic/manager.py"],
+            root=REPO, rules=["GL003"])
+        assert fs == []
+
+
+# --------------------------------------------------------------------------- #
+# GL004 retrace hazard
+# --------------------------------------------------------------------------- #
+
+
+class TestGL004:
+    def test_mutable_defaults_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            def op(x, axes=[], opts={}):
+                return x
+        """, rules=["GL004"])
+        assert rule_ids(fs) == ["GL004", "GL004"]
+
+    def test_scalar_default_on_jitted_fn(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x, lr=0.1):
+                return x * lr
+        """, rules=["GL004"])
+        assert rule_ids(fs) == ["GL004"]
+        assert "lr=0.1" in fs[0].message
+
+    def test_safe_defaults_ok(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def eager(x, lr=0.1, name=None, shape=(2, 3)):
+                return x
+
+            @jax.jit
+            def step(x, axis=None, mode="mean"):
+                return x
+        """, rules=["GL004"])
+        assert fs == []
+
+
+# --------------------------------------------------------------------------- #
+# GL005 RNG key reuse
+# --------------------------------------------------------------------------- #
+
+
+class TestGL005:
+    def test_straight_line_reuse(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def init(key, shape):
+                a = jax.random.normal(key, shape)
+                b = jax.random.uniform(key, shape)
+                return a + b
+        """, rules=["GL005"])
+        assert rule_ids(fs) == ["GL005"]
+        assert "already consumed" in fs[0].message
+
+    def test_loop_reuse(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def layers(key, n):
+                return [jax.random.normal(key, (4,)) for _ in range(n)] and [
+                    jax.random.normal(key, (4,)) for _ in range(n)]
+        """, rules=["GL005"])
+        # two comprehension uses of the same key in one statement
+        assert rule_ids(fs) == ["GL005"]
+
+    def test_for_loop_without_split(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def noise(key, n):
+                out = []
+                for _ in range(n):
+                    out.append(jax.random.normal(key, (3,)))
+                return out
+        """, rules=["GL005"])
+        assert rule_ids(fs) == ["GL005"]
+        assert "loop" in fs[0].message
+
+    def test_split_between_uses_ok(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def init(key, shape):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1, shape)
+                b = jax.random.uniform(k2, shape)
+                return a + b
+
+            def loop(key, n):
+                out = []
+                for _ in range(n):
+                    key, sub = jax.random.split(key)
+                    out.append(jax.random.normal(sub, (3,)))
+                return out
+        """, rules=["GL005"])
+        assert fs == []
+
+    def test_exclusive_branches_ok(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def sample(key, flag, shape):
+                if flag:
+                    return jax.random.normal(key, shape)
+                return jax.random.uniform(key, shape)
+        """, rules=["GL005"])
+        assert fs == []
+
+    def test_split_inside_with_body_ok(self, tmp_path):
+        # the in-tree idiom: RNG code under `with tracing_guard(True):`.
+        # The body must be scanned sequentially — a flat scan would see the
+        # second sampler before the split reassignment and false-positive
+        fs = lint_src(tmp_path, """
+            import jax
+            from paddle_tpu.framework.core import tracing_guard
+
+            def sample(key, ctx, shape):
+                with tracing_guard(True):
+                    a = jax.random.normal(key, shape)
+                    key = jax.random.split(key)[0]
+                    b = jax.random.normal(key, shape)
+                return a + b
+        """, rules=["GL005"])
+        assert fs == []
+
+    def test_reuse_inside_with_body_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def sample(key, ctx, shape):
+                with ctx:
+                    a = jax.random.normal(key, shape)
+                    b = jax.random.uniform(key, shape)
+                return a + b
+        """, rules=["GL005"])
+        assert rule_ids(fs) == ["GL005"]
+
+    def test_numpy_stateful_api_ok(self, tmp_path):
+        # np.random.normal(loc, scale) has no key argument — positional
+        # Name reuse there must not be mistaken for key reuse
+        fs = lint_src(tmp_path, """
+            import numpy as np
+
+            def jitter(mu, sigma):
+                a = np.random.normal(mu, sigma)
+                b = np.random.normal(mu, sigma)
+                return a + b
+        """, rules=["GL005"])
+        assert fs == []
+
+
+# --------------------------------------------------------------------------- #
+# hot-path audit regressions (satellite: per-step host syncs in hapi fit)
+# --------------------------------------------------------------------------- #
+
+
+class TestHotPathAudit:
+    def test_recorder_callback_accepts_device_loss(self, tmp_path):
+        # between log points the fit loop hands callbacks the 0-d device
+        # Tensor; the jsonl/VisualDL recorder must still capture every step
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi.callbacks import VisualDL
+
+        cb = VisualDL(str(tmp_path / "vdl"))
+        cb.epoch = 0
+        cb.on_train_batch_end(0, {"loss": 0.5})
+        cb.on_train_batch_end(1, {"loss": paddle.to_tensor(0.25)})
+        cb.on_train_batch_end(2, {"loss": "not-a-number"})
+        recorded = (tmp_path / "vdl" / "train.jsonl").read_text().splitlines()
+        assert [json.loads(l)["value"] for l in recorded] == [0.5, 0.25]
+
+    def test_fit_passes_float_at_log_boundaries(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi.callbacks import Callback
+
+        seen = {}
+
+        class Spy(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen[step] = logs["loss"]
+
+        net = nn.Linear(2, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=net.parameters()),
+            loss=nn.MSELoss())
+        x = np.ones((4, 2), "float32")
+        y = np.ones((4, 1), "float32")
+        batches = [(paddle.to_tensor(x), paddle.to_tensor(y))] * 4
+        model.fit(batches, epochs=1, log_freq=2, verbose=0,
+                  callbacks=[Spy()])
+        assert isinstance(seen[0], float) and isinstance(seen[2], float)
+        # non-log steps carry the device scalar, float()-able on demand
+        assert float(seen[1]) >= 0.0
+
+    def test_fit_honors_train_batch_override(self):
+        # subclassing train_batch is the paddle.Model extension point; the
+        # async fast path must defer to it, not silently bypass it
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        calls = []
+
+        class Custom(paddle.Model):
+            def train_batch(self, inputs, labels=None, update=True):
+                calls.append(1)
+                return super().train_batch(inputs, labels, update)
+
+        net = nn.Linear(2, 1)
+        model = Custom(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=net.parameters()),
+            loss=nn.MSELoss())
+        b = (paddle.to_tensor(np.ones((4, 2), "float32")),
+             paddle.to_tensor(np.ones((4, 1), "float32")))
+        model.fit([b, b], epochs=1, verbose=0)
+        assert len(calls) == 2
+
+
+# --------------------------------------------------------------------------- #
+# suppression comments
+# --------------------------------------------------------------------------- #
+
+
+class TestSuppression:
+    SRC = """
+        def probe(store):
+            try:
+                store.get("k")
+            except Exception:{comment}
+                pass
+    """
+
+    def test_matching_rule_suppressed(self, tmp_path):
+        fs = lint_src(tmp_path, self.SRC.format(
+            comment="  # graftlint: disable=GL003 best-effort probe"))
+        assert fs == []
+
+    def test_all_and_multi_rule_lists(self, tmp_path):
+        fs = lint_src(tmp_path, self.SRC.format(
+            comment="  # graftlint: disable=all"))
+        assert fs == []
+        fs = lint_src(tmp_path, self.SRC.format(
+            comment="  # graftlint: disable=GL001, GL003"))
+        assert fs == []
+
+    def test_wrong_rule_does_not_suppress(self, tmp_path):
+        fs = lint_src(tmp_path, self.SRC.format(
+            comment="  # graftlint: disable=GL001"))
+        assert rule_ids(fs) == ["GL003"]
+
+
+# --------------------------------------------------------------------------- #
+# baseline round-trip
+# --------------------------------------------------------------------------- #
+
+_VIOLATION = """
+    def probe(store):
+        try:
+            store.get("k")
+        except Exception:
+            pass
+"""
+_VIOLATION_TWICE = _VIOLATION + """
+    def probe2(store):
+        try:
+            store.get("j")
+        except Exception:
+            pass
+"""
+
+
+class TestBaseline:
+    def test_round_trip_add_fix_shrink(self, tmp_path):
+        src_file = tmp_path / "mod.py"
+        bl_file = tmp_path / "baseline.json"
+
+        # 1. two violations, baselined: clean
+        src_file.write_text(textwrap.dedent(_VIOLATION_TWICE))
+        findings = lint_paths([src_file], root=tmp_path)
+        assert len(findings) == 2
+        baseline_mod.save(bl_file, findings)
+        new, known = baseline_mod.partition(findings, baseline_mod.load(bl_file))
+        assert new == [] and len(known) == 2
+
+        # 2. a third identical violation appears: exactly one NEW finding
+        #    (fingerprints are count-aware, not just set membership)
+        src3 = textwrap.dedent(_VIOLATION_TWICE) + textwrap.dedent(_VIOLATION).replace("probe", "probe3")
+        src_file.write_text(src3)
+        new, known = baseline_mod.partition(
+            lint_paths([src_file], root=tmp_path), baseline_mod.load(bl_file))
+        assert len(new) == 1 and len(known) == 2
+
+        # 3. fix all but one and rewrite: the baseline shrinks
+        src_file.write_text(textwrap.dedent(_VIOLATION))
+        remaining = lint_paths([src_file], root=tmp_path)
+        baseline_mod.save(bl_file, remaining)
+        entries = json.loads(bl_file.read_text())["entries"]
+        assert sum(entries.values()) == 1
+
+    def test_line_moves_do_not_invalidate(self, tmp_path):
+        src_file = tmp_path / "mod.py"
+        bl_file = tmp_path / "baseline.json"
+        src_file.write_text(textwrap.dedent(_VIOLATION))
+        baseline_mod.save(bl_file, lint_paths([src_file], root=tmp_path))
+        # unrelated code added above: line numbers shift, fingerprint stays
+        src_file.write_text("x = 1\ny = 2\n" + textwrap.dedent(_VIOLATION))
+        new, known = baseline_mod.partition(
+            lint_paths([src_file], root=tmp_path), baseline_mod.load(bl_file))
+        assert new == [] and len(known) == 1
+
+    def test_parse_errors_never_baselined(self, tmp_path):
+        # GL000 fingerprints carry no snippet — baselining one would absorb
+        # every future parse error in the file (truncated checkouts included)
+        src_file = tmp_path / "broken.py"
+        bl_file = tmp_path / "baseline.json"
+        src_file.write_text("def oops(:\n")
+        findings = lint_paths([src_file], root=tmp_path)
+        assert rule_ids(findings) == ["GL000"]
+        baseline_mod.save(bl_file, findings)
+        assert json.loads(bl_file.read_text())["entries"] == {}
+        new, known = baseline_mod.partition(
+            findings, baseline_mod.load(bl_file))
+        assert rule_ids(new) == ["GL000"] and known == []
+
+    def test_corrupt_baseline_rejected(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"version": 99, "entries": {}}')
+        with pytest.raises(ValueError):
+            baseline_mod.load(bad)
+        bad.write_text('{"no_entries": true}')
+        with pytest.raises(ValueError):
+            baseline_mod.load(bad)
+
+
+# --------------------------------------------------------------------------- #
+# CLI: exit codes, --stats, self-lint
+# --------------------------------------------------------------------------- #
+
+
+class TestCLI:
+    def _fixture_dir(self, tmp_path):
+        (tmp_path / "clean.py").write_text("def ok(x):\n    return x\n")
+        (tmp_path / "dirty.py").write_text(textwrap.dedent(_VIOLATION))
+        return tmp_path
+
+    def test_exit_codes_in_process(self, tmp_path, capsys):
+        d = self._fixture_dir(tmp_path)
+        assert cli_main([str(d / "clean.py"), "--root", str(d)]) == 0
+        assert cli_main([str(d / "dirty.py"), "--root", str(d)]) == 1
+        out = capsys.readouterr().out
+        assert "dirty.py" in out and "GL003" in out
+        # internal errors: missing path / unknown rule / unreadable baseline
+        assert cli_main([str(d / "missing.py")]) == 2
+        assert cli_main([str(d), "--rules", "GL999"]) == 2
+        assert cli_main([]) == 2
+
+    def test_stats_exact_counts(self, tmp_path, capsys):
+        d = self._fixture_dir(tmp_path)
+        assert cli_main([str(d), "--root", str(d), "--stats"]) == 1
+        lines = capsys.readouterr().out.strip().splitlines()
+        stats = dict(l.split(" ", 1) for l in lines)
+        assert stats["GL003"] == "total=1 new=1"
+        assert stats["GL001"] == "total=0 new=0"
+        assert stats["TOTAL"] == "total=1 new=1"
+
+    def test_baseline_flag_and_write(self, tmp_path, capsys):
+        d = self._fixture_dir(tmp_path)
+        bl = d / "bl.json"
+        assert cli_main([str(d), "--root", str(d),
+                         "--write-baseline", str(bl)]) == 0
+        assert cli_main([str(d), "--root", str(d), "--baseline", str(bl)]) == 0
+        out = capsys.readouterr().out
+        assert "clean" in out
+
+    def test_subprocess_entry_point(self, tmp_path):
+        # the documented invocation: `python -m tools.graftlint <path>`
+        d = self._fixture_dir(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", str(d / "dirty.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1, proc.stderr
+        assert "GL003" in proc.stdout
+
+
+class TestSelfLint:
+    @pytest.fixture(scope="class")
+    def tree_findings(self):
+        return lint_paths([REPO / "paddle_tpu"], root=REPO)
+
+    def test_no_findings_above_baseline(self, tree_findings):
+        baseline = baseline_mod.load(REPO / "tools/graftlint/baseline.json")
+        new, known = baseline_mod.partition(tree_findings, baseline)
+        assert new == [], "new graftlint findings:\n" + "\n".join(
+            f.format() for f in new)
+
+    def test_baseline_not_stale(self, tree_findings):
+        # every baselined entry still corresponds to a real finding — fixed
+        # violations must be removed (--write-baseline) so the ratchet
+        # tightens instead of leaving headroom for regressions
+        baseline = baseline_mod.load(REPO / "tools/graftlint/baseline.json")
+        current = baseline_mod.aggregate(tree_findings)
+        stale = {k: n - current.get(k, 0) for k, n in baseline.items()
+                 if n > current.get(k, 0)}
+        assert stale == {}, f"stale baseline entries: {stale}"
+
+    def test_every_rule_registered(self):
+        assert list(RULES) == ["GL001", "GL002", "GL003", "GL004", "GL005"]
+
+
+# --------------------------------------------------------------------------- #
+# runtime cross-check: GL001 static and dynamic analyses agree
+# --------------------------------------------------------------------------- #
+
+# one snippet, linted statically AND executed dynamically
+_HOST_SYNC_SNIPPET = textwrap.dedent("""
+    import paddle_tpu
+
+    def traced_loss(t):
+        return float(t.numpy())
+
+    step = paddle_tpu.jit.to_static(traced_loss)
+""")
+
+
+@pytest.fixture
+def runtime_checks():
+    from tools.graftlint import runtime as rt
+
+    rt.install_runtime_checks("raise")
+    try:
+        yield rt
+    finally:
+        rt.uninstall_runtime_checks()
+        rt.reset_runtime_events()
+
+
+class TestRuntimeCrossCheck:
+    def test_static_and_dynamic_agree(self, tmp_path, runtime_checks):
+        import paddle_tpu as paddle
+
+        # static: GL001 flags the deliberate host-sync-under-trace
+        f = tmp_path / "snippet.py"
+        f.write_text(_HOST_SYNC_SNIPPET)
+        static = lint_paths([f], root=tmp_path, rules=["GL001"])
+        assert {fi.rule for fi in static} == {"GL001"}
+        flagged_lines = {fi.line for fi in static}
+
+        # dynamic: executing the same snippet raises at trace time
+        ns: dict = {}
+        exec(compile(_HOST_SYNC_SNIPPET, str(f), "exec"), ns)
+        with pytest.raises(runtime_checks.HostSyncInTraceError):
+            ns["step"](paddle.to_tensor(2.5))
+        events = runtime_checks.runtime_report()["host_syncs_in_trace"]
+        assert events and events[0]["kind"] == "array"
+        # the sync the observer caught is on a line the static pass flagged
+        assert any("float(t.numpy())" in fi.snippet for fi in static)
+        assert flagged_lines  # non-empty: both analyses located the sync
+
+    def test_without_checks_sot_fallback_is_silent(self):
+        # baseline behavior the runtime mode exists to surface: the same
+        # sync silently degrades to SOT graph-break capture (perf loss, no
+        # error) when enforcement is off
+        import paddle_tpu as paddle
+
+        ns: dict = {}
+        exec(_HOST_SYNC_SNIPPET, ns)
+        out = ns["step"](paddle.to_tensor(2.5))
+        assert float(out) == 2.5
+        assert ns["step"]._sot_fallen_back[0] is True
+
+    def test_tracing_guard_direct(self, runtime_checks):
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.core import tracing_guard
+
+        t = paddle.to_tensor(1.0)
+        assert float(t) == 1.0  # outside tracing: observer passes through
+        with tracing_guard(True):
+            with pytest.raises(runtime_checks.HostSyncInTraceError):
+                t.numpy()
+        assert t.tolist() == 1.0  # guard restored
+
+    def test_warn_mode(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.core import tracing_guard
+        from tools.graftlint import runtime as rt
+
+        rt.install_runtime_checks("warn")
+        try:
+            t = paddle.to_tensor(3.0)
+            with tracing_guard(True):
+                with pytest.warns(rt.GraftlintRuntimeWarning):
+                    v = t.numpy()
+            assert float(v) == 3.0
+        finally:
+            rt.uninstall_runtime_checks()
+            rt.reset_runtime_events()
+
+    def test_report_surfaces_dispatch_cache_stats(self, runtime_checks):
+        import paddle_tpu as paddle
+
+        a = paddle.to_tensor([1.0, 2.0])
+        _ = a + a  # at least one dispatched op
+        rep = runtime_checks.runtime_report()
+        assert set(rep) >= {"host_syncs_in_trace", "traced_op_census",
+                            "dispatch_cache", "uncacheable_ops",
+                            "bypassed_ops"}
+        assert {"hits", "misses", "bypass"} <= set(rep["dispatch_cache"])
+        assert isinstance(rep["uncacheable_ops"], list)
+        text = runtime_checks.format_report()
+        assert "dispatch cache" in text
+
+    def test_op_census_counts_traced_ops(self, runtime_checks):
+        import paddle_tpu as paddle
+
+        def f(t):
+            return t + t
+
+        stepped = paddle.jit.to_static(f)
+        stepped(paddle.to_tensor([1.0, 2.0]))
+        census = runtime_checks.runtime_report()["traced_op_census"]
+        assert census, "expected ops dispatched under tracing to be counted"
+
+    def test_env_activation(self, monkeypatch):
+        import paddle_tpu
+        from tools.graftlint import runtime as rt
+
+        assert not rt._state["installed"]
+        # the conventional disable spellings must NOT arm strict raise mode
+        for off in ("0", "false", "OFF", ""):
+            monkeypatch.setenv("GRAFTLINT_RUNTIME", off)
+            paddle_tpu._maybe_install_graftlint_runtime()
+            assert not rt._state["installed"], f"GRAFTLINT_RUNTIME={off!r}"
+        monkeypatch.setenv("GRAFTLINT_RUNTIME", "1")
+        paddle_tpu._maybe_install_graftlint_runtime()
+        try:
+            assert rt._state["installed"] and rt._state["mode"] == "raise"
+        finally:
+            rt.uninstall_runtime_checks()
+            rt.reset_runtime_events()

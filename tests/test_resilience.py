@@ -110,6 +110,45 @@ class TestCommitProtocol:
             load_state_dict(_sd(0.0), mgr.path_for(1))
         assert latest_checkpoint(str(tmp_path)) is None
 
+    def test_restore_latest_rolls_back_partial_load(self, tmp_path,
+                                                    monkeypatch):
+        """A corruption hit on a LATER shard (multi-file checkpoints) aborts
+        the in-place load mid-loop; restore_latest must roll the mutated
+        tensors back so 'no valid checkpoint' really means untouched live
+        state, not a silent half-restored mix (graftlint-era review find)."""
+        from paddle_tpu.distributed.checkpoint import manager as mgr_mod
+
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_sd(7.0), 1)
+
+        def half_load_then_die(state_dict, path, **kw):
+            # emulate the multi-file failure mode: first tensor mutated,
+            # then a later shard file turns out corrupt
+            state_dict["w"]._value = state_dict["w"]._value * 0.0
+            raise CheckpointCorruptError("later shard crc mismatch")
+
+        monkeypatch.setattr(mgr_mod, "load_state_dict", half_load_then_die)
+        live = _sd(3.0)
+        assert mgr.restore_latest(live) is None
+        np.testing.assert_array_equal(np.asarray(live["w"].numpy()),
+                                      np.full((6,), 3.0, np.float32))
+
+    def test_restore_latest_rolls_back_on_key_mismatch(self, tmp_path):
+        """A live state_dict key absent from the checkpoint raises KeyError
+        mid-load (schema change between save and resume); the error must
+        propagate — it is NOT corruption — but only after the rollback."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_sd(7.0), 1)
+        live = _sd(3.0)
+        live["brand_new_param"] = paddle.to_tensor(
+            np.full((2,), 5.0, np.float32))
+        with pytest.raises(KeyError):
+            mgr.restore_latest(live)
+        # dict order put "w" first: it was overwritten with 7.0 before the
+        # KeyError — the rollback must have undone that
+        np.testing.assert_array_equal(np.asarray(live["w"].numpy()),
+                                      np.full((6,), 3.0, np.float32))
+
     def test_keep_last_n_rotation(self, tmp_path):
         mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
         for s in range(1, 6):

@@ -1,0 +1,42 @@
+"""graftlint: TPU/JAX-aware static analysis for the paddle_tpu tree.
+
+The dispatch layer documents the failure modes that silently kill TPU
+performance and distributed correctness — host syncs inside traces, retrace
+storms, rank-conditional collectives that deadlock a slice — but until now
+nothing enforced them. graftlint is the enforcement: an AST pass with a
+pluggable rule registry (rules.py), a checked-in baseline so pre-existing
+violations are tracked without blocking (baseline.py), and a runtime
+cross-check mode (runtime.py) that validates the static reachability analysis
+against actual host syncs observed through the framework's sync-observer hook.
+
+Rules:
+    GL001  host-sync-in-trace        .numpy()/float()/int()/bool()/`if t:`
+                                     reachable from traced regions
+    GL002  rank-conditional-collective  collective call under an `if rank`
+                                     branch — static deadlock hazard
+    GL003  swallowed-exception       `except Exception:` that neither logs
+                                     nor re-raises
+    GL004  retrace-hazard            mutable default args; Python-scalar
+                                     defaults on jitted functions
+    GL005  rng-key-reuse             same key passed to two random.* samplers
+                                     without a split/reassignment
+
+Suppress a finding in place with `# graftlint: disable=GL00N <reason>` on the
+offending line. CLI: `python -m tools.graftlint paddle_tpu --baseline
+tools/graftlint/baseline.json` (exit 0 clean / 1 new findings / 2 internal
+error).
+"""
+
+from .engine import Finding, LintProject, lint_paths, load_project, run_rules
+from .rules import RULES, Rule, get_rules
+
+__all__ = [
+    "Finding",
+    "LintProject",
+    "lint_paths",
+    "load_project",
+    "run_rules",
+    "RULES",
+    "Rule",
+    "get_rules",
+]

@@ -1,0 +1,131 @@
+"""graftlint CLI.
+
+    python -m tools.graftlint paddle_tpu --baseline tools/graftlint/baseline.json
+    python -m tools.graftlint paddle_tpu --stats
+    python -m tools.graftlint --list-rules
+
+Exit codes (asserted by tests/test_graftlint.py):
+    0  clean — no findings above the baseline
+    1  new findings (or parse errors)
+    2  internal error (bad arguments, unreadable baseline, linter crash)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+from collections import Counter
+from pathlib import Path
+
+from . import baseline as baseline_mod
+from .engine import lint_paths
+from .rules import RULES, get_rules
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL = 2
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="TPU/JAX-aware static analysis (rules GL001-GL005; "
+                    "see docs/LINTING.md)")
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="baseline JSON; findings within it do not fail the run")
+    p.add_argument("--write-baseline", metavar="FILE",
+                   help="write current findings as the new baseline and exit 0")
+    p.add_argument("--rules", metavar="IDS",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-rule totals (total/new) instead of findings")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings covered by the baseline")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--root", metavar="DIR", default=None,
+                   help="directory paths are reported relative to (default: cwd)")
+    return p
+
+
+def run(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in get_rules():
+            print(f"{rule.id}  {rule.title}")
+            print(f"       {rule.rationale}\n")
+        return EXIT_CLEAN
+
+    if not args.paths:
+        print("graftlint: no paths given (try: python -m tools.graftlint "
+              "paddle_tpu)", file=sys.stderr)
+        return EXIT_INTERNAL
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return EXIT_INTERNAL
+
+    findings = lint_paths(args.paths, root=args.root, rules=rule_ids)
+
+    if args.write_baseline:
+        baseline_mod.save(args.write_baseline, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}")
+        return EXIT_CLEAN
+
+    baseline = Counter()
+    if args.baseline:
+        baseline = baseline_mod.load(args.baseline)
+    new, known = baseline_mod.partition(findings, baseline)
+
+    if args.stats:
+        totals = Counter(f.rule for f in findings)
+        news = Counter(f.rule for f in new)
+        for rule in sorted(set(totals) | set(RULES)):
+            print(f"{rule} total={totals.get(rule, 0)} new={news.get(rule, 0)}")
+        print(f"TOTAL total={len(findings)} new={len(new)}")
+    else:
+        shown = findings if args.show_baselined else new
+        for f in shown:
+            marker = "" if f in new else " [baselined]"
+            print(f.format() + marker)
+        if new:
+            print(f"graftlint: {len(new)} new finding(s)"
+                  + (f" ({len(known)} baselined)" if known else ""))
+        elif known:
+            print(f"graftlint: clean ({len(known)} baselined finding(s))")
+        else:
+            print("graftlint: clean")
+
+    return EXIT_FINDINGS if new else EXIT_CLEAN
+
+
+def main(argv=None) -> int:
+    try:
+        return run(argv)
+    except SystemExit as e:  # argparse --help / bad flags
+        code = e.code if isinstance(e.code, int) else EXIT_INTERNAL
+        return EXIT_CLEAN if code == 0 else EXIT_INTERNAL
+    except BrokenPipeError:
+        # output truncated by a downstream `| head` — not an error; devnull
+        # stdout so the interpreter's flush-at-exit doesn't re-raise
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return EXIT_CLEAN
+    except Exception:
+        traceback.print_exc()
+        print("graftlint: internal error (exit 2)", file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+if __name__ == "__main__":
+    sys.exit(main())

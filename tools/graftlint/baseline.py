@@ -1,0 +1,77 @@
+"""Baseline bookkeeping: track pre-existing violations without blocking.
+
+A baseline is a JSON multiset of finding fingerprints — (rule, path, stripped
+source line), deliberately line-number-free so edits elsewhere in a file do
+not invalidate entries. The contract:
+
+- a finding whose fingerprint count is within the baseline is *known* (shown
+  only with --show-baselined, never fails the run);
+- a finding beyond its baselined count is *new* and fails the run (exit 1);
+- fixing a violation then rewriting with --write-baseline shrinks the file —
+  the ratchet only ever tightens unless someone deliberately regenerates.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Iterable, Sequence
+
+from .engine import PARSE_ERROR_RULE, Finding
+
+FORMAT_VERSION = 1
+
+
+def aggregate(findings: Iterable[Finding]) -> Counter:
+    return Counter(f.fingerprint for f in findings)
+
+
+def save(path: Path | str, findings: Sequence[Finding]) -> None:
+    # GL000 parse errors are never baselineable: their fingerprint carries no
+    # snippet, so one baselined entry would absorb EVERY future parse error
+    # in that file — a truncated checkout must always fail loudly
+    counts = aggregate(f for f in findings if f.rule != PARSE_ERROR_RULE)
+    payload = {
+        "version": FORMAT_VERSION,
+        "comment": "graftlint baseline — regenerate with --write-baseline; "
+                   "entries are rule|path|source-line fingerprints",
+        "entries": {k: counts[k] for k in sorted(counts)},
+    }
+    Path(path).write_text(json.dumps(payload, indent=1) + "\n",
+                          encoding="utf-8")
+
+
+def load(path: Path | str) -> Counter:
+    raw = json.loads(Path(path).read_text(encoding="utf-8"))
+    if not isinstance(raw, dict) or "entries" not in raw:
+        raise ValueError(f"{path}: not a graftlint baseline (missing 'entries')")
+    if raw.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"{path}: baseline format version {raw.get('version')!r} "
+            f"unsupported (expected {FORMAT_VERSION})")
+    entries = raw["entries"]
+    bad = {k: v for k, v in entries.items()
+           if not isinstance(v, int) or v < 1}
+    if bad:
+        raise ValueError(f"{path}: non-positive baseline counts: {sorted(bad)}")
+    return Counter(entries)
+
+
+def partition(findings: Sequence[Finding], baseline: Counter):
+    """Split findings into (new, baselined).
+
+    Within one fingerprint the *first* occurrences (file order) are treated
+    as the baselined ones — arbitrary but stable, and irrelevant to exit
+    status, which depends only on counts.
+    """
+    remaining = Counter(baseline)
+    new: list[Finding] = []
+    known: list[Finding] = []
+    for f in findings:
+        if f.rule != PARSE_ERROR_RULE and remaining[f.fingerprint] > 0:
+            remaining[f.fingerprint] -= 1
+            known.append(f)
+        else:
+            new.append(f)  # parse errors are always new, never baselined
+    return new, known

@@ -1,0 +1,164 @@
+"""Lint engine: file discovery, parsing, suppression comments, rule driving.
+
+The engine is deliberately framework-agnostic: it knows nothing about jax or
+paddle_tpu. Rules (rules.py) receive a `LintProject` — every parsed file plus
+cheap cross-file indexes — and yield `Finding`s; the engine filters the ones
+suppressed by `# graftlint: disable=RULE` comments and orders the rest.
+
+A finding's identity for baseline purposes is (path, rule, source-line text),
+not the line *number* — unrelated edits above a tracked violation must not
+invalidate the baseline (see baseline.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Sequence
+
+# `# graftlint: disable=GL001` or `disable=GL001,GL003 free-text reason`
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+# Rule id for files the engine itself cannot analyze (syntax errors): always
+# reported, never suppressible, so a truncated checkout fails loudly.
+PARSE_ERROR_RULE = "GL000"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix path relative to the lint root
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    snippet: str = ""
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline (see module doc)."""
+        return f"{self.rule}|{self.path}|{self.snippet}"
+
+
+@dataclass
+class FileContext:
+    """One parsed source file."""
+
+    path: Path
+    rel_path: str
+    source: str
+    lines: list[str]
+    tree: ast.AST
+    # line number -> set of suppressed rule ids ("all" suppresses everything)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+
+    def snippet_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()[:160]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.rel_path, line, col, message,
+                       self.snippet_at(line))
+
+    def is_suppressed(self, f: Finding) -> bool:
+        sup = self.suppressions.get(f.line)
+        return bool(sup) and (f.rule in sup or "all" in sup)
+
+
+@dataclass
+class LintProject:
+    root: Path
+    files: list[FileContext]
+    parse_errors: list[Finding] = field(default_factory=list)
+
+    def by_rel_path(self, rel_path: str) -> FileContext | None:
+        for ctx in self.files:
+            if ctx.rel_path == rel_path:
+                return ctx
+        return None
+
+
+def _parse_suppressions(lines: Sequence[str]) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        if "graftlint" not in text:
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out[i] = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+    return out
+
+
+def iter_py_files(paths: Iterable[Path]) -> list[Path]:
+    seen: dict[Path, None] = {}
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                seen.setdefault(f.resolve(), None)
+        elif p.is_file():
+            seen.setdefault(p.resolve(), None)
+        else:
+            # a *missing* path is caller error (CLI exit 2), distinct from an
+            # existing-but-unparsable file (a GL000 finding, exit 1)
+            raise FileNotFoundError(f"graftlint: no such file or directory: {p}")
+    return list(seen)
+
+
+def load_project(paths: Sequence[Path | str], root: Path | str | None = None) -> LintProject:
+    root = Path(root) if root is not None else Path.cwd()
+    root = root.resolve()
+    files: list[FileContext] = []
+    parse_errors: list[Finding] = []
+    for f in iter_py_files([Path(p) for p in paths]):
+        try:
+            rel = f.relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            source = f.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=str(f))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            parse_errors.append(Finding(
+                PARSE_ERROR_RULE, rel, line, 0,
+                f"file could not be parsed: {e.__class__.__name__}: {e}"))
+            continue
+        lines = source.splitlines()
+        files.append(FileContext(
+            path=f, rel_path=rel, source=source, lines=lines, tree=tree,
+            suppressions=_parse_suppressions(lines)))
+    return LintProject(root=root, files=files, parse_errors=parse_errors)
+
+
+def run_rules(project: LintProject, rules=None) -> list[Finding]:
+    """Run rules over the project; drop suppressed findings; stable order."""
+    from .rules import get_rules
+
+    ctx_by_path = {ctx.rel_path: ctx for ctx in project.files}
+    findings: list[Finding] = list(project.parse_errors)
+    for rule in get_rules(rules):
+        for f in rule.check(project):
+            ctx = ctx_by_path.get(f.path)
+            if ctx is not None and ctx.is_suppressed(f):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Sequence[Path | str], root: Path | str | None = None,
+               rules=None) -> list[Finding]:
+    """One-call API used by the tests: discover, parse, run, filter."""
+    return run_rules(load_project(paths, root=root), rules=rules)

@@ -1,0 +1,710 @@
+"""Rule registry and the five shipped rules (GL001-GL005).
+
+Each rule is a singleton with an id, a one-line title, a rationale (shown by
+`--list-rules` and docs/LINTING.md), and `check(project) -> Iterable[Finding]`.
+Register new rules with `@register`; the CLI and tests pick them up through
+`get_rules()`.
+
+The analyses are deliberately syntactic over-approximations with documented
+escape hatches (suppression comments, the baseline): on a 256-chip job the
+cost asymmetry is extreme — a false positive costs one `# graftlint: disable=`
+comment, a missed host sync or rank-conditional collective costs a hung slice.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import OrderedDict
+from typing import Iterable, Iterator
+
+from .engine import FileContext, Finding, LintProject
+
+RULES: "OrderedDict[str, Rule]" = OrderedDict()
+
+
+def register(cls):
+    inst = cls()
+    RULES[inst.id] = inst
+    return cls
+
+
+def get_rules(ids=None) -> list["Rule"]:
+    if ids is None:
+        return list(RULES.values())
+    unknown = [i for i in ids if i not in RULES]
+    if unknown:
+        raise KeyError(f"unknown rule id(s): {', '.join(unknown)}")
+    return [RULES[i] for i in ids]
+
+
+class Rule:
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def check(self, project: LintProject) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------- #
+# shared AST helpers
+# --------------------------------------------------------------------------- #
+
+
+def _call_name(func: ast.AST) -> str | None:
+    """Simple name of a call target: `f(...)` -> "f", `a.b.f(...)` -> "f"."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _dotted_chain(node: ast.AST) -> list[str]:
+    """`jax.random.normal` -> ["jax", "random", "normal"]; [] if not a pure
+    name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _walk_skipping_defs(body: Iterable[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class bodies
+    (those are analyzed as their own regions) or lambdas."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# --------------------------------------------------------------------------- #
+# GL001 host-sync-in-trace
+# --------------------------------------------------------------------------- #
+
+# Entry points that put Python code under a jax trace: decorator names,
+# wrapper calls whose function-valued arguments get traced, and the in-tree
+# `with tracing_guard(True):` convention from framework/core.py.
+_TRACE_DECORATORS = {"jit", "pjit", "to_static"}
+_TRACE_TRANSFORMS = {
+    "jit", "pjit", "to_static", "grad", "value_and_grad", "vjp", "jvp",
+    "vmap", "pmap", "scan", "while_loop", "fori_loop", "cond", "checkpoint",
+    "remat", "shard_map", "custom_vjp",
+}
+_HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
+_HOST_CASTS = {"float", "int", "bool"}
+# Builtins whose result is a plain Python scalar even on tracers — casting
+# them is not a device sync (false-positive guard: `float(len(xs))`).
+_CAST_SAFE_CALLS = {"len", "ord", "hash", "round", "id"}
+
+
+class _FnRecord:
+    __slots__ = ("node", "ctx", "name", "qualname", "params", "calls",
+                 "is_root", "guard_bodies", "scalar_defaults")
+
+    def __init__(self, node, ctx, qualname):
+        self.node = node
+        self.ctx = ctx
+        self.name = node.name
+        self.qualname = qualname
+        args = node.args
+        self.params = {a.arg for a in
+                       args.posonlyargs + args.args + args.kwonlyargs}
+        self.calls: set[str] = set()
+        self.is_root = False
+        self.guard_bodies: list[list[ast.stmt]] = []
+        self.scalar_defaults: list[tuple[str, ast.AST]] = []
+
+
+def _decorator_marks_traced(dec: ast.AST) -> bool:
+    """@jax.jit / @to_static / @functools.partial(jax.jit, ...) forms."""
+    if isinstance(dec, ast.Call):
+        name = _call_name(dec.func)
+        if name in _TRACE_DECORATORS:
+            return True
+        if name == "partial" and dec.args:
+            return _call_name(dec.args[0]) in _TRACE_DECORATORS
+        return False
+    return _call_name(dec) in _TRACE_DECORATORS
+
+
+def _is_tracing_guard_with(node: ast.With) -> bool:
+    return any(
+        isinstance(item.context_expr, ast.Call)
+        and _call_name(item.context_expr.func) == "tracing_guard"
+        for item in node.items
+    )
+
+
+def _file_collectors(project: LintProject) -> list["_GL001Collector"]:
+    """One AST collection pass per file per lint run, shared by GL001 and
+    GL004 (memoized on the project — ~260 files would otherwise be walked
+    once per consuming rule)."""
+    cache = getattr(project, "_graftlint_fn_collectors", None)
+    if cache is None:
+        cache = []
+        for ctx in project.files:
+            col = _GL001Collector(ctx)
+            col.visit(ctx.tree)
+            cache.append(col)
+        project._graftlint_fn_collectors = cache
+    return cache
+
+
+class _GL001Collector(ast.NodeVisitor):
+    """Per-file pass: function records, call edges, trace roots."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.stack: list[_FnRecord] = []
+        self.fns: list[_FnRecord] = []
+        self.root_names: set[str] = set()
+
+    def _visit_fn(self, node):
+        qual = ".".join([f.name for f in self.stack] + [node.name])
+        rec = _FnRecord(node, self.ctx, qual)
+        rec.is_root = any(_decorator_marks_traced(d) for d in node.decorator_list)
+        args = node.args
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            rec.scalar_defaults.append((a.arg, d))
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                rec.scalar_defaults.append((a.arg, d))
+        self.fns.append(rec)
+        self.stack.append(rec)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_With(self, node: ast.With):
+        if self.stack and _is_tracing_guard_with(node):
+            self.stack[-1].guard_bodies.append(node.body)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        name = _call_name(node.func)
+        if self.stack and name is not None:
+            if isinstance(node.func, ast.Name):
+                self.stack[-1].calls.add(name)
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in ("self", "cls")):
+                self.stack[-1].calls.add(name)
+        # `jax.jit(step)`, `jax.value_and_grad(loss_fn)`, `jax.lax.scan(body,…)`
+        # and `functools.partial(jax.jit, ...)(fn)`: function-valued args get
+        # traced when the wrapper runs
+        if name in _TRACE_TRANSFORMS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    self.root_names.add(arg.id)
+        self.generic_visit(node)
+
+
+@register
+class HostSyncInTrace(Rule):
+    id = "GL001"
+    title = "host sync reachable from a traced region"
+    rationale = (
+        "Inside jax tracing, .numpy()/.item()/.tolist(), float()/int()/bool() "
+        "casts, and `if tensor:` force the tracer to concretize — at best a "
+        "TracerArrayConversionError, at worst (through a fallback path) a "
+        "device-to-host round trip per step that serializes the TPU pipeline. "
+        "Reachability: functions decorated with jit/to_static, functions "
+        "passed to jax transforms, bodies of `with tracing_guard(True):`, "
+        "plus everything they transitively call. Call edges are matched by "
+        "simple name *within the defining file* — cross-file matching on "
+        "names like `step`/`fn`/`update` drowned true positives in "
+        "collisions; helpers traced from another module belong in that "
+        "module's own trace roots."
+    )
+
+    def check(self, project: LintProject) -> Iterable[Finding]:
+        collectors = _file_collectors(project)
+        traced: set[int] = set()  # id(_FnRecord)
+        traced_recs: list[_FnRecord] = []
+
+        for col in collectors:
+            by_name: dict[str, list[_FnRecord]] = {}
+            for rec in col.fns:
+                by_name.setdefault(rec.name, []).append(rec)
+            worklist: list[str] = list(col.root_names)
+
+            def guard_callees(rec: _FnRecord, _wl=worklist):
+                for body in rec.guard_bodies:
+                    for node in _walk_skipping_defs(body):
+                        if isinstance(node, ast.Call):
+                            n = _call_name(node.func)
+                            if n:
+                                _wl.append(n)
+
+            def mark(rec: _FnRecord, _wl=worklist):
+                if id(rec) in traced:
+                    return
+                traced.add(id(rec))
+                traced_recs.append(rec)
+                _wl.extend(rec.calls)
+                guard_callees(rec)
+
+            for rec in col.fns:
+                if rec.is_root:
+                    mark(rec)
+                else:
+                    # a tracing_guard body is traced even when its enclosing
+                    # function is not — seed its callees
+                    guard_callees(rec)
+
+            while worklist:
+                name = worklist.pop()
+                for rec in by_name.get(name, []):
+                    mark(rec)
+
+        seen: set[tuple[str, int, str]] = set()
+        findings: list[Finding] = []
+
+        def emit(ctx, node, msg):
+            f = ctx.finding(self.id, node, msg)
+            key = (f.path, f.line, msg)
+            if key not in seen:
+                seen.add(key)
+                findings.append(f)
+
+        def scan_region(ctx: FileContext, body, params: set[str], where: str):
+            # `params` is non-empty only for directly-jitted functions: their
+            # positional args ARE tracers. Transitively-traced helpers often
+            # take Python config values (flags, axis ints) where `if flag:`
+            # is a legitimate static branch.
+            for node in _walk_skipping_defs(body):
+                if isinstance(node, ast.Call):
+                    if (isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _HOST_SYNC_METHODS):
+                        emit(ctx, node,
+                             f"`.{node.func.attr}()` is a host sync but is "
+                             f"reachable under tracing via {where}")
+                    elif (isinstance(node.func, ast.Name)
+                          and node.func.id in _HOST_CASTS
+                          and len(node.args) == 1 and not node.keywords):
+                        arg = node.args[0]
+                        if isinstance(arg, ast.Constant):
+                            continue
+                        if (isinstance(arg, ast.Call)
+                                and _call_name(arg.func) in _CAST_SAFE_CALLS):
+                            continue
+                        emit(ctx, node,
+                             f"`{node.func.id}()` concretizes its argument "
+                             f"under tracing (reached via {where})")
+                elif isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    if isinstance(test, ast.Name) and test.id in params:
+                        emit(ctx, test,
+                             f"`if {test.id}:` on a traced-function parameter "
+                             "forces a concrete bool under tracing "
+                             f"(reached via {where})")
+
+        for rec in traced_recs:
+            scan_region(rec.ctx, rec.node.body,
+                        rec.params if rec.is_root else set(),
+                        f"traced function `{rec.qualname}`")
+        # guard bodies inside non-traced functions still execute under trace
+        for col in collectors:
+            for rec in col.fns:
+                if id(rec) in traced:
+                    continue
+                for body in rec.guard_bodies:
+                    scan_region(rec.ctx, body, set(),
+                                f"`with tracing_guard(...)` in `{rec.qualname}`")
+        return findings
+
+
+# --------------------------------------------------------------------------- #
+# GL002 rank-conditional collective
+# --------------------------------------------------------------------------- #
+
+# Unambiguous collective entry points (paddle_tpu.distributed.collective and
+# eager_multiproc): every rank in the group must reach the call site.
+_COLLECTIVES = {
+    "all_reduce", "all_gather", "all_gather_object", "reduce_scatter",
+    "alltoall", "alltoall_single", "broadcast_object_list",
+    "scatter_object_list", "allreduce_value", "allgather_values",
+    "allgather_objects", "broadcast_value", "broadcast_objects",
+    "store_allreduce_group", "sync_global_devices",
+}
+# Names that are collectives only in dotted form (`dist.reduce(...)`); the
+# bare names collide with builtins/stdlib (functools.reduce, Event.wait).
+_COLLECTIVES_DOTTED_ONLY = {"reduce", "scatter", "broadcast", "barrier"}
+_RANK_NAMES = {"rank", "local_rank", "global_rank", "rank_id"}
+_RANK_CALLS = {"get_rank", "process_index", "get_group_rank", "local_rank"}
+
+
+def _mentions_rank(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in _RANK_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _RANK_NAMES:
+            return True
+        if isinstance(node, ast.Call) and _call_name(node.func) in _RANK_CALLS:
+            return True
+    return False
+
+
+def _is_collective_call(node: ast.Call) -> bool:
+    name = _call_name(node.func)
+    if name in _COLLECTIVES:
+        return True
+    return (name in _COLLECTIVES_DOTTED_ONLY
+            and isinstance(node.func, ast.Attribute))
+
+
+@register
+class RankConditionalCollective(Rule):
+    id = "GL002"
+    title = "collective call under a rank-conditional branch"
+    rationale = (
+        "A collective reached by only a subset of ranks deadlocks the group: "
+        "participating chips park in the all-reduce while the excluded rank "
+        "never arrives, and the job hangs with no error until the comm "
+        "watchdog (or the operator) kills it. Branching on rank is fine for "
+        "logging or p2p send/recv — but group collectives must be reached "
+        "unconditionally by every member."
+    )
+
+    def check(self, project: LintProject) -> Iterable[Finding]:
+        for ctx in project.files:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.If) or not _mentions_rank(node.test):
+                    continue
+                # a nested `if rank` is visited by ast.walk on its own — stop
+                # at it here so each call site is reported exactly once,
+                # against its nearest rank-conditional
+                for sub in self._iter_branch(node.body + node.orelse):
+                    if isinstance(sub, ast.Call) and _is_collective_call(sub):
+                        yield ctx.finding(
+                            self.id, sub,
+                            f"collective `{_call_name(sub.func)}` inside a "
+                            "rank-conditional branch — ranks that skip the "
+                            "branch never join and the group deadlocks")
+
+    @classmethod
+    def _iter_branch(cls, nodes) -> Iterator[ast.AST]:
+        stack = list(nodes)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.If) and _mentions_rank(node.test):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------- #
+# GL003 swallowed exception
+# --------------------------------------------------------------------------- #
+
+_BROAD_EXC = {"Exception", "BaseException"}
+# Handlers inside these functions are exempt: raising out of GC/teardown is
+# worse than the swallow (store.py __del__ is the canonical case).
+_GL003_ALLOWLIST_FUNCS = {"__del__"}
+
+
+@register
+class SwallowedException(Rule):
+    id = "GL003"
+    title = "broad exception handler that neither logs nor re-raises"
+    rationale = (
+        "`except Exception: pass` turns real faults — a dead TCPStore, a "
+        "poisoned collective, a corrupt checkpoint shard — into silent "
+        "no-ops; PR 1's resilience machinery can only recover from faults it "
+        "can observe. A broad handler must log, re-raise, or carry an "
+        "explicit `# graftlint: disable=GL003 <reason>`."
+    )
+
+    def check(self, project: LintProject) -> Iterable[Finding]:
+        for ctx in project.files:
+            allowed_spans: list[tuple[int, int]] = []
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node.name in _GL003_ALLOWLIST_FUNCS):
+                    allowed_spans.append((node.lineno, node.end_lineno or node.lineno))
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not self._is_broad(node.type):
+                    continue
+                if any(lo <= node.lineno <= hi for lo, hi in allowed_spans):
+                    continue
+                if self._handles(node.body):
+                    continue
+                caught = "bare `except:`" if node.type is None else \
+                    f"`except {ast.unparse(node.type)}:`"
+                yield ctx.finding(
+                    self.id, node,
+                    f"{caught} swallows the error without logging or "
+                    "re-raising — narrow the type, log it, or add "
+                    "`# graftlint: disable=GL003 <reason>`")
+
+    @staticmethod
+    def _is_broad(type_node) -> bool:
+        if type_node is None:
+            return True
+        names = ([type_node] if not isinstance(type_node, ast.Tuple)
+                 else list(type_node.elts))
+        return any(_call_name(n) in _BROAD_EXC for n in names)
+
+    @staticmethod
+    def _handles(body) -> bool:
+        """A handler 'handles' if it raises or makes any call (logging,
+        cleanup, metric bump) — pure pass/continue/return/assignment does not."""
+        for node in _walk_skipping_defs(body):
+            if isinstance(node, (ast.Raise, ast.Call)):
+                return True
+        return False
+
+
+# --------------------------------------------------------------------------- #
+# GL004 retrace hazard
+# --------------------------------------------------------------------------- #
+
+
+@register
+class RetraceHazard(Rule):
+    id = "GL004"
+    title = "argument pattern that defeats the dispatch/trace cache"
+    rationale = (
+        "The eager dispatch cache (framework/core.py) keys on the *values* "
+        "of defaults and closures: a mutable default ({}, []) either fails "
+        "to hash (permanent eager bypass — per-call retrace) or churns the "
+        "key every time it is mutated. On jitted entry points, a Python "
+        "int/float default is baked per *value*: each new scalar is a fresh "
+        "trace — the weak-type retrace storm core.py:657-821 documents."
+    )
+
+    def check(self, project: LintProject) -> Iterable[Finding]:
+        for col in _file_collectors(project):
+            ctx = col.ctx
+            for rec in col.fns:
+                for name, default in rec.scalar_defaults:
+                    if self._is_mutable(default):
+                        yield ctx.finding(
+                            self.id, default,
+                            f"mutable default for `{name}` in "
+                            f"`{rec.qualname}` — unhashable in dispatch-cache "
+                            "keys (permanent per-call retrace) and shared "
+                            "across calls")
+                    elif rec.is_root and isinstance(default, ast.Constant) \
+                            and type(default.value) in (int, float):
+                        yield ctx.finding(
+                            self.id, default,
+                            f"Python scalar default `{name}={default.value!r}` "
+                            f"on jitted `{rec.qualname}` — every distinct "
+                            "value passed at a call site triggers a retrace; "
+                            "make it a static arg or close over it")
+
+    @staticmethod
+    def _is_mutable(node: ast.AST) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("list", "dict", "set", "bytearray"))
+
+
+# --------------------------------------------------------------------------- #
+# GL005 RNG key reuse
+# --------------------------------------------------------------------------- #
+
+_SAMPLERS = {
+    "normal", "uniform", "randint", "bernoulli", "categorical", "gumbel",
+    "truncated_normal", "permutation", "choice", "bits", "exponential",
+    "laplace", "poisson", "rademacher", "beta", "gamma", "dirichlet",
+}
+# numpy's stateful API shares sampler names but takes loc/scale, not keys
+_NON_KEYED_ROOTS = {"np", "numpy"}
+
+
+def _sampler_key_arg(node: ast.Call):
+    """Return the Name node of the key argument if this is a keyed sampler."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    if node.func.attr not in _SAMPLERS:
+        return None
+    chain = _dotted_chain(node.func)
+    if chain and chain[0] in _NON_KEYED_ROOTS:
+        return None
+    if "random" not in chain[:-1] and not any(
+            kw.arg == "key" for kw in node.keywords):
+        return None
+    for kw in node.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value
+    if node.args and isinstance(node.args[0], ast.Name):
+        return node.args[0]
+    return None
+
+
+def _assigned_names(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+
+    def targets(t):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets(e)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                targets(item.optional_vars)
+    # walrus anywhere inside the statement
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.NamedExpr):
+            targets(node.target)
+    return out
+
+
+def _terminates(stmts: list) -> bool:
+    """Block ends on a statement control flow cannot fall out of."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+@register
+class RngKeyReuse(Rule):
+    id = "GL005"
+    title = "same RNG key consumed by two sampler calls"
+    rationale = (
+        "jax PRNG keys are pure values: passing one key to two random.* "
+        "samplers yields *identical* randomness — correlated dropout masks, "
+        "duplicated init noise — silently. Every consumption must be "
+        "preceded by a fresh `split` (or fold_in), i.e. a reassignment of "
+        "the key variable."
+    )
+
+    def check(self, project: LintProject) -> Iterable[Finding]:
+        for ctx in project.files:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._scan_block(ctx, node.body, {}, set())[2]
+
+    def _scan_block(self, ctx, body, used: dict, assigned: set):
+        """Sequential scan. Returns (used, assigned, findings); `used` maps
+        key-var name -> line of its consuming use."""
+        findings: list[Finding] = []
+        used = dict(used)
+        assigned = set(assigned)
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                u1, a1, f1 = self._scan_block(ctx, stmt.body, used, assigned)
+                u2, a2, f2 = self._scan_block(ctx, stmt.orelse, used, assigned)
+                findings += f1 + f2
+                # exclusive branches: a use in one arm does not collide with
+                # the other; later code collides only with arms that can
+                # fall through (a `return`ing arm never reaches it)
+                if _terminates(stmt.body):
+                    u1, a1 = used, set()
+                if stmt.orelse and _terminates(stmt.orelse):
+                    u2, a2 = used, set()
+                used = {**u1, **u2}
+                assigned |= a1 | a2
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                loop_assigned = _assigned_names(stmt)
+                for s in stmt.body:
+                    loop_assigned |= _assigned_names(s)
+                u1, a1, f1 = self._scan_block(ctx, stmt.body, used,
+                                              assigned | loop_assigned)
+                findings += f1
+                # a key consumed in the body but never reassigned inside the
+                # loop is reused verbatim on every iteration
+                for name, line in u1.items():
+                    if name not in loop_assigned and name not in used:
+                        findings.append(Finding(
+                            self.id, ctx.rel_path, line, 0,
+                            f"key `{name}` is consumed inside a loop without "
+                            "being split/reassigned per iteration — every "
+                            "pass replays the same randomness",
+                            ctx.snippet_at(line)))
+                used.update(u1)
+                assigned |= a1 | loop_assigned
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # context expressions evaluate first, then the body runs
+                # sequentially — flattening the whole With as one statement
+                # would see body samplers before body reassignments
+                for item in stmt.items:
+                    self._consume_samplers(ctx, item.context_expr, used,
+                                           findings)
+                for name in _assigned_names(stmt):
+                    used.pop(name, None)
+                    assigned.add(name)
+                used, a1, f1 = self._scan_block(ctx, stmt.body, used, assigned)
+                findings += f1
+                assigned |= a1
+                continue
+            if isinstance(stmt, ast.Try):
+                u1, a1, f1 = self._scan_block(ctx, stmt.body, used, assigned)
+                findings += f1
+                for h in stmt.handlers:
+                    u2, a2, f2 = self._scan_block(ctx, h.body, used, assigned)
+                    findings += f2
+                    u1.update(u2)
+                    a1 |= a2
+                u3, a3, f3 = self._scan_block(
+                    ctx, stmt.orelse + stmt.finalbody, u1, assigned | a1)
+                findings += f3
+                used, assigned = u3, assigned | a1 | a3
+                continue
+
+            # plain statement: find sampler uses in document order, then
+            # apply this statement's assignments (`k2 = normal(k2, …)` is
+            # use-then-assign: the read happens before the rebind)
+            self._consume_samplers(ctx, stmt, used, findings)
+            for name in _assigned_names(stmt):
+                used.pop(name, None)
+                assigned.add(name)
+        return used, assigned, findings
+
+    def _consume_samplers(self, ctx, node, used: dict, findings: list):
+        """Record/flag every keyed sampler call under `node` in source order."""
+        calls = [n for n in _walk_skipping_defs([node])
+                 if isinstance(n, ast.Call)]
+        calls.sort(key=lambda n: (n.lineno, n.col_offset))
+        for call in calls:
+            key_arg = _sampler_key_arg(call)
+            if key_arg is None:
+                continue
+            name = key_arg.id
+            if name in used:
+                findings.append(Finding(
+                    self.id, ctx.rel_path, key_arg.lineno, key_arg.col_offset,
+                    f"key `{name}` already consumed by a sampler on line "
+                    f"{used[name]} — split it (`k1, k2 = split({name})`) "
+                    "before sampling again", ctx.snippet_at(key_arg.lineno)))
+            else:
+                used[name] = key_arg.lineno
