@@ -10,6 +10,13 @@ resnet50 + SD-scale unet),
 one JSON line per rung, headline line LAST so drivers reading the final line
 still get the headline.
 
+`--emit-metrics[=path]` (default path: $BENCH_METRICS_PATH or
+bench_metrics.jsonl) installs an observability StepTimeline over the timed
+loops, appending one JSON step record per timed step — host-sync counts,
+dispatch-cache hit/miss/bypass deltas, comm_task intervals — so BENCH_*.json
+rounds can be read next to the per-step telemetry that produced them, not
+just the wall-time headline.
+
 Rungs: gpt3_1p3b gpt3_350m gpt3_125m llama_7bshape bert_base resnet50
 unet_sd cpu_smoke.
 """
@@ -77,7 +84,7 @@ def _probe_backend(max_tries=2, timeout_s=180.0):
     return None, err
 
 
-def _timed_steps(step_fn, steps, trace_dir=None, warmup=3):
+def _timed_steps(step_fn, steps, trace_dir=None, warmup=3, rung=None):
     """Warmed-up timed loop; returns seconds/step. step_fn() must return a
     device value whose float() forces completion.
 
@@ -99,10 +106,20 @@ def _timed_steps(step_fn, steps, trace_dir=None, warmup=3):
             device_trace_dir=trace_dir,
             on_trace_ready=profiler.export_chrome_tracing(trace_dir))
         prof.start()
+    from paddle_tpu.observability import spans as _obs_spans
+
+    tl = _obs_spans.active_timeline()  # installed by --emit-metrics
     t0 = time.perf_counter()
     last = None
-    for _ in range(steps):
+    for i in range(steps):
+        if tl is not None:
+            tl.step_begin(i)
         last = step_fn()
+        if tl is not None:
+            # rung tag: a BENCH_MATRIX run interleaves several rungs'
+            # step sequences in one JSONL — untagged records with repeating
+            # step indices would be unattributable
+            tl.step_end(extra={"rung": rung} if rung else None)
         if prof is not None:
             prof.step()
     _ = float(last)
@@ -253,7 +270,8 @@ def run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir=None):
             dist.env.set_global_mesh(None)
             continue
 
-    dt = _timed_steps(lambda: step(ids, labels), steps, trace_dir)
+    dt = _timed_steps(lambda: step(ids, labels), steps, trace_dir,
+                      rung=name)
     flops = _decoder_flops(cfg, batch, seq)
     extra = {}
     if name == "gpt3_1p3b":
@@ -286,7 +304,8 @@ def run_llama_rung(on_tpu):
     step, ids, labels = _decoder_step(cfg, batch, seq, on_tpu,
                                       sharding_stage=2)
     _ = float(step(ids, labels))
-    dt = _timed_steps(lambda: step(ids, labels), steps)
+    dt = _timed_steps(lambda: step(ids, labels), steps,
+                      rung="llama_7bshape")
     return _emit(f"llama_7bshape_flashmask_bs{batch}x{seq}", dt,
                  _decoder_flops(cfg, batch, seq), batch * seq)
 
@@ -326,7 +345,8 @@ def run_bert_rung(on_tpu):
     mlab = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, n_mask)))
     nlab = paddle.to_tensor(rng.integers(0, 2, (batch,)))
     _ = float(step([ids, tt, am, mpos], [mlab, nlab]))
-    dt = _timed_steps(lambda: step([ids, tt, am, mpos], [mlab, nlab]), steps)
+    dt = _timed_steps(lambda: step([ids, tt, am, mpos], [mlab, nlab]),
+                      steps, rung="bert_base")
     h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
     # encoder 12h^2/layer params, attention quadratic, + MLM head on n_mask
     n_enc = 12 * L * h * h
@@ -375,7 +395,8 @@ def run_unet_rung(on_tpu):
     noise = paddle.to_tensor(
         rng.normal(size=(batch, cfg.out_channels, hw, hw)).astype(np.float32))
     _ = float(step([noisy, t, ctx], noise))
-    dt = _timed_steps(lambda: step([noisy, t, ctx], noise), steps)
+    dt = _timed_steps(lambda: step([noisy, t, ctx], noise), steps,
+                      rung="unet_sd")
     peak, kind = _peak_flops(jax.devices()[0])
     line = {
         "metric": f"unet_sd_bs{batch}x{hw}_{kind.replace(' ', '_')}",
@@ -411,13 +432,30 @@ def run_resnet_rung(on_tpu):
     img = paddle.to_tensor(rng.normal(size=(batch, 3, hw, hw)).astype(np.float32))
     lab = paddle.to_tensor(rng.integers(0, 1000, (batch, 1)))
     _ = float(step(img, lab))
-    dt = _timed_steps(lambda: step(img, lab), steps)
+    dt = _timed_steps(lambda: step(img, lab), steps, rung="resnet50")
     flops = 3.0 * fwd_flops * batch  # fwd + ~2x bwd
     return _emit(f"resnet50_bs{batch}" if on_tpu else f"resnet18_bs{batch}",
                  dt, flops, extra={"images_per_sec": round(batch / dt, 1)})
 
 
 def main():
+    # --emit-metrics[=path]: step-timeline JSONL alongside the perf line
+    # (env-var style config everywhere else; this one is a flag so BENCH
+    # driver scripts can toggle it without touching the environment block)
+    metrics_path = None
+    for a in sys.argv[1:]:
+        if a == "--emit-metrics":
+            metrics_path = os.environ.get("BENCH_METRICS_PATH",
+                                          "bench_metrics.jsonl")
+        elif a.startswith("--emit-metrics="):
+            metrics_path = a.split("=", 1)[1]
+    if metrics_path:
+        from paddle_tpu.observability import enable_step_timeline
+
+        enable_step_timeline(jsonl_path=metrics_path)
+        print(json.dumps({"metric": "step_timeline_jsonl",
+                          "path": metrics_path}), file=sys.stderr)
+
     backend, init_error = _probe_backend()
     if backend is None:
         # Nothing initialized in this process yet; pin to CPU so the smoke

@@ -108,6 +108,7 @@ from .distributed import DataParallel  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402
+from . import observability  # noqa: E402
 from . import device  # noqa: E402
 from . import fft  # noqa: E402
 from . import distribution  # noqa: E402

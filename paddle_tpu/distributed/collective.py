@@ -62,6 +62,36 @@ class ReduceOp:
     AVG = "avg"
 
 
+# Telemetry: every eager collective bumps per-op call/byte counters in the
+# observability registry. The compiled-form `primitives` are deliberately
+# uninstrumented — they execute inside traces, where emitting a host-side
+# metric is exactly the GL006 hazard graftlint flags.
+_obs_handles = None  # lazy HandleCache (metrics imported on first use)
+
+
+def _record_collective(op: str, *tensors):
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability.metrics import HandleCache
+
+        _obs_handles = HandleCache(lambda reg: (
+            reg.counter("collective_calls_total",
+                        "eager collective invocations", ("op",)),
+            reg.counter("collective_bytes_total",
+                        "payload bytes through eager collectives", ("op",)),
+        ))
+    calls, bytes_ = _obs_handles.get()
+    nbytes = 0
+    for t in tensors:
+        v = getattr(t, "_value", t)
+        shape = getattr(v, "shape", None)
+        if shape is not None:
+            nbytes += int(np.prod(shape)) * np.dtype(v.dtype).itemsize
+    calls.inc(1, op=op)
+    if nbytes:
+        bytes_.inc(nbytes, op=op)
+
+
 _groups: dict[int, "Group"] = {}
 _next_gid = [0]
 
@@ -232,6 +262,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     returns a task, like the reference. Under multi-controller
     (jax.process_count() > 1) each process contributes its local tensor and
     the reduction runs over the global device set."""
+    _record_collective("all_reduce", tensor)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -262,6 +293,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    _record_collective("reduce", tensor)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -285,6 +317,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """reference: dist.all_gather(list, t) — after the call the list holds
     every rank's tensor. Global-array view: slices of the stacked array;
     multi-controller: one compiled all-gather over the processes."""
+    _record_collective("all_gather", tensor)
     g = _grp(group)
     if g.nranks == 1:
         tensor_list.append(Tensor(tensor._value))
@@ -307,6 +340,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def all_gather_object(object_list, obj, group=None):
+    _record_collective("all_gather_object")
     g = _grp(group)
     if g.nranks == 1:
         object_list.append(obj)
@@ -323,6 +357,7 @@ def all_gather_object(object_list, obj, group=None):
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
     """Each rank gets one shard of the reduction. Input: list of [nranks,...]
     stacked tensors (or tensors per destination)."""
+    _record_collective("reduce_scatter", *tensor_list)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -352,6 +387,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
+    _record_collective("broadcast", tensor)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -369,6 +405,7 @@ def broadcast(tensor, src, group=None, sync_op=True):
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    _record_collective("broadcast_object_list")
     g = _grp(group)
     mp = _mp_active(g)
     if mp is not None:
@@ -377,6 +414,7 @@ def broadcast_object_list(object_list, src=0, group=None):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _record_collective("scatter", *(tensor_list or [tensor]))
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -399,6 +437,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    _record_collective("scatter_object_list")
     g = _grp(group)
     if g.nranks == 1:
         if in_object_list:
@@ -417,6 +456,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """rank i sends in[j] to rank j: transpose of the (src, dst) grid."""
+    _record_collective("alltoall", *in_tensor_list)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -450,6 +490,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     rank i's row is the concat of chunks for each destination, so the global
     transform is the (src, dst) chunk-grid transpose — identical to what
     lax.all_to_all compiles to over a mesh axis."""
+    _record_collective("alltoall_single", in_tensor)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -496,6 +537,7 @@ _mailbox: dict = {}
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    _record_collective("send", tensor)
     g = _grp(group)
     mp = _mp_active(g)
     if mp is not None:
@@ -510,6 +552,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    _record_collective("recv", tensor)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -562,6 +605,7 @@ def batch_isend_irecv(p2p_op_list):
 
 
 def barrier(group=None):
+    _record_collective("barrier")
     import jax
 
     g = _grp(group)

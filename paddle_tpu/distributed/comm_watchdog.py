@@ -16,16 +16,68 @@ from __future__ import annotations
 import contextlib
 import ctypes
 import os
+import re
 import threading
+import time
 
 from ..framework import native
 
-__all__ = ["enable", "disable", "comm_task", "drain_report", "timeout_count",
-           "inflight"]
+__all__ = ["enable", "disable", "comm_task", "drain_report", "peek_report",
+           "report_events", "timeout_count", "inflight", "add_task_observer",
+           "remove_task_observer"]
 
 _wd = None
 _lock = threading.Lock()
 _spill = None  # (thread, stop_event)
+
+# Report plumbing: the native buffer is drain-only (watchdog_drain_report
+# clears it), but two consumers need the text — the destructive spill/trainer
+# path AND the flight recorder's non-destructive peek. Every native drain is
+# pumped into a bounded Python-side history; drain_report() consumes from a
+# cursor (each caller sees fresh text exactly once, preserving the old
+# append-to-file semantics), peek_report()/report_events() read the whole
+# retained history without advancing anything.
+_report_history: list[str] = []
+_report_cursor = 0  # history entries already handed out by drain_report
+_REPORT_HISTORY_CAP = 1 << 20  # bytes retained for peek
+
+# comm_task interval observers: fn(desc, start_ns, end_ns), fired on region
+# exit whether or not the native watchdog is enabled — the StepTimeline's
+# source for per-step collective/blocking intervals.
+_task_observers: list = []
+
+
+def add_task_observer(fn):
+    _task_observers.append(fn)
+    return fn
+
+
+def remove_task_observer(fn):
+    try:
+        _task_observers.remove(fn)
+    except ValueError:
+        pass
+
+
+def _pump_locked():
+    """Drain the native buffer into the history (caller holds _lock)."""
+    global _report_cursor
+    if _wd is None:
+        return
+    lib, h = _wd
+    buf = ctypes.create_string_buffer(1 << 16)
+    n = lib.watchdog_drain_report(h, buf, len(buf))
+    if n > 0:
+        _report_history.append(buf.raw[:n].decode(errors="replace"))
+        # bound retained memory: trim oldest entries past the cap. Entries
+        # not yet handed out by drain_report are trimmed too (a peek-only
+        # consumer must not grow the history without bound on a long job
+        # with many timeouts) — under cap pressure the oldest text is gone
+        # for both channels, newest-first retention being the useful half.
+        total = sum(len(s) for s in _report_history)
+        while total > _REPORT_HISTORY_CAP and len(_report_history) > 1:
+            total -= len(_report_history.pop(0))
+            _report_cursor = max(0, _report_cursor - 1)
 
 
 def _spill_once(path, fatal):
@@ -108,6 +160,7 @@ def disable():
         spill[0].join(timeout=2)
     with _lock:
         if _wd is not None:
+            _pump_locked()  # keep unread report text peekable post-disable
             lib, h = _wd
             _wd = None
             if spill is None or not spill[0].is_alive():
@@ -118,7 +171,11 @@ def disable():
 
 @contextlib.contextmanager
 def comm_task(desc: str, timeout_seconds=None):
-    """Track a blocking region; no-op when the watchdog is off."""
+    """Track a blocking region; near-free when the watchdog is off and no
+    task observer is registered. Observers see every region's (desc, start,
+    end) interval regardless of whether the native watchdog is enabled —
+    deadline enforcement needs the native thread, timeline stitching does
+    not."""
     with _lock:
         wd = _wd
         if wd is None:
@@ -127,29 +184,75 @@ def comm_task(desc: str, timeout_seconds=None):
             lib, h = wd
             tid = lib.watchdog_register(h, desc.encode(),
                                         int((timeout_seconds or 0) * 1000))
-    if tid is None:
-        yield
-        return
+    t0 = time.perf_counter_ns() if _task_observers else None
     try:
         yield
     finally:
-        with _lock:
-            # a concurrent disable() may have destroyed the handle while
-            # this region ran — completing on it would be a use-after-free
-            if _wd is wd:
-                lib.watchdog_complete(h, tid)
+        if tid is not None:
+            with _lock:
+                # a concurrent disable() may have destroyed the handle while
+                # this region ran — completing on it would be a use-after-free
+                if _wd is wd:
+                    lib.watchdog_complete(h, tid)
+        # t0 None: no observer was registered at entry — an observer added
+        # mid-region must not receive a garbage interval
+        if _task_observers and t0 is not None:
+            t1 = time.perf_counter_ns()
+            for fn in list(_task_observers):
+                try:
+                    fn(desc, t0, t1)
+                except Exception as e:  # noqa: BLE001
+                    # an observer failure must not mask the region's own
+                    # exception (we are in a finally block)
+                    import sys
+
+                    print(f"[comm_watchdog] task observer failed: {e!r}",
+                          file=sys.stderr)
 
 
 def drain_report() -> str:
+    """Return report text not yet consumed by a previous drain (destructive
+    with respect to other drain callers, like the native buffer was — the
+    spill thread's append-to-file contract depends on it — but the text is
+    retained for peek_report()/report_events())."""
+    global _report_cursor
     # under _lock: disable() must not watchdog_destroy the handle while a
     # reader (the spill thread in particular) is inside the native call
     with _lock:
-        if _wd is None:
-            return ""
-        lib, h = _wd
-        buf = ctypes.create_string_buffer(1 << 16)
-        n = lib.watchdog_drain_report(h, buf, len(buf))
-    return buf.raw[:n].decode(errors="replace")
+        _pump_locked()
+        fresh = "".join(_report_history[_report_cursor:])
+        _report_cursor = len(_report_history)
+    return fresh
+
+
+def peek_report() -> str:
+    """Non-destructive view of every retained report line (flight recorder's
+    channel — reading here never steals text from the spill path)."""
+    with _lock:
+        _pump_locked()
+        return "".join(_report_history)
+
+
+# native/watchdog.cc line shape:
+#   [watchdog] task 3 'train_step/7' exceeded 500ms (1234ms elapsed)
+_REPORT_LINE_RE = re.compile(
+    r"\[watchdog\] task (\d+) '(.*)' exceeded (\d+)ms \((\d+)ms")
+
+
+def report_events() -> list[dict]:
+    """peek_report() parsed into structured events: one dict per timed-out
+    task with task id, description, deadline and observed elapsed time."""
+    events = []
+    for line in peek_report().splitlines():
+        m = _REPORT_LINE_RE.search(line)
+        if m:
+            events.append({
+                "task_id": int(m.group(1)),
+                "desc": m.group(2),
+                "timeout_ms": int(m.group(3)),
+                "elapsed_ms": int(m.group(4)),
+            })
+    return events
 
 
 def timeout_count() -> int:

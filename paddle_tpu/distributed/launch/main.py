@@ -87,6 +87,7 @@ class Pod:
         self.procs: list[subprocess.Popen] = []
         self.log_paths: list[str] = []
         self.wd_report_paths: list[str] = []
+        self.flight_paths: list[str] = []
 
     def spawn(self):
         args = self.args
@@ -120,6 +121,17 @@ class Pod:
                 pass
             env["PADDLE_WD_REPORT_FILE"] = wd_path
             self.wd_report_paths.append(wd_path)
+            # flight-recorder post-mortem channel: ResilientTrainer (and the
+            # SIGTERM/excepthook handlers it installs) dump the last-N-steps
+            # telemetry ring here; folded into the worker log on death like
+            # the watchdog spill
+            fl_path = log_path + ".flight"
+            try:
+                os.unlink(fl_path)
+            except OSError:
+                pass
+            env["PADDLE_FLIGHT_FILE"] = fl_path
+            self.flight_paths.append(fl_path)
             if args.max_restart > 0:
                 # restartable pods escalate hangs: the spill thread's
                 # FatalError line trips the LogWatcher → teardown → respawn
@@ -158,26 +170,33 @@ class Pod:
                 f.close()
 
     def dump_watchdog_reports(self):
-        """Post-mortem: drain each worker's comm-watchdog spill file into its
-        log (and the launcher's stderr) before respawning, so the stuck-step
-        report survives the restart that destroys the worker process."""
-        for local, (log_path, wd_path) in enumerate(
-                zip(self.log_paths, self.wd_report_paths)):
-            try:
-                with open(wd_path) as f:
-                    report = f.read().strip()
-            except OSError:
-                continue
-            if not report:
-                continue
-            banner = (f"\n[launch] comm-watchdog post-mortem for worker "
-                      f"{local} (restart {self.restart_count}):\n{report}\n")
-            try:
-                with open(log_path, "a") as f:
-                    f.write(banner)
-            except OSError:
-                pass
-            print(banner, file=sys.stderr)
+        """Post-mortem: drain each worker's comm-watchdog spill file AND its
+        flight-recorder dump into its log (and the launcher's stderr) before
+        respawning, so the stuck-step report and the last-N-steps telemetry
+        ring survive the restart that destroys the worker process."""
+        channels = [
+            ("comm-watchdog", self.wd_report_paths),
+            ("flight-recorder", self.flight_paths),
+        ]
+        for kind, paths in channels:
+            for local, (log_path, src_path) in enumerate(
+                    zip(self.log_paths, paths)):
+                try:
+                    with open(src_path) as f:
+                        report = f.read().strip()
+                except OSError:
+                    continue
+                if not report:
+                    continue
+                banner = (f"\n[launch] {kind} post-mortem for worker "
+                          f"{local} (restart {self.restart_count}):"
+                          f"\n{report}\n")
+                try:
+                    with open(log_path, "a") as f:
+                        f.write(banner)
+                except OSError:
+                    pass
+                print(banner, file=sys.stderr)
 
     def watch(self, fatal_evt=None):
         """Block until the pod finishes, a worker fails, or the log watcher

@@ -41,6 +41,9 @@ import sys
 import threading
 import time
 
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
 from . import comm_watchdog, faults
 from .checkpoint.manager import CheckpointManager
 
@@ -50,6 +53,28 @@ __all__ = ["ResilientTrainer", "run_with_recovery", "REFORM_EXIT_CODE"]
 # launcher's restart loop (distinct from faults.FAULT_EXIT_CODE and from
 # ordinary crashes only for log readability — any nonzero code restarts)
 REFORM_EXIT_CODE = 75
+
+# Trainer metric handles, resolved per registry instance (HandleCache: a
+# reset_default_registry() between two trainers must not strand the second
+# one emitting into a dead registry).
+_tm = _metrics.HandleCache(lambda reg: {
+    "step": reg.histogram(
+        "trainer_step_seconds", "ResilientTrainer wall time per step"),
+    "ckpt": reg.histogram(
+        "trainer_checkpoint_save_seconds",
+        "checkpoint save latency (submit time for async saves)"),
+    "hb_age": reg.gauge(
+        "trainer_heartbeat_age_seconds",
+        "seconds since this rank's last elastic heartbeat, sampled at "
+        "step boundaries"),
+    "wd": reg.counter(
+        "trainer_watchdog_timeouts_total",
+        "comm-watchdog deadline overruns observed by the trainer"),
+})
+
+# a persistently-slow job trips the watchdog every step; one full post-
+# mortem per overrun would grow PADDLE_FLIGHT_FILE without bound
+_WD_DUMP_MIN_INTERVAL_S = 60.0
 
 
 class ResilientTrainer:
@@ -101,6 +126,12 @@ class ResilientTrainer:
         self._log = log or (lambda msg: print(f"[resilience] {msg}",
                                               file=sys.stderr, flush=True))
         self._timeouts_seen = 0
+        self._last_beat = None  # monotonic time of the latest heartbeat
+        # monotonic time of the last overrun dump; None = never dumped
+        # (0.0 would silently suppress the FIRST dump for the first
+        # _WD_DUMP_MIN_INTERVAL_S of system uptime — monotonic starts at
+        # boot, and a preempted VM restarts its job well inside a minute)
+        self._last_wd_dump = None
 
     # ------------------------------------------------------------------ #
 
@@ -132,6 +163,7 @@ class ResilientTrainer:
         from .fleet.elastic.manager import ElasticStatus
 
         self.elastic.heartbeat()
+        self._last_beat = time.monotonic()
         status = self.elastic.watch()
         if status == ElasticStatus.HOLD:
             deadline = time.monotonic() + self.hold_timeout
@@ -162,6 +194,7 @@ class ResilientTrainer:
     def _check_watchdog(self, step):
         n = comm_watchdog.timeout_count()
         if n > self._timeouts_seen:
+            new = n - self._timeouts_seen
             self._timeouts_seen = n
             report = comm_watchdog.drain_report()
             # the spill thread may have drained it to the report file first;
@@ -169,6 +202,18 @@ class ResilientTrainer:
             self._log(f"step {step}: comm watchdog flagged a deadline "
                       f"overrun ({n} total)"
                       + (f"\n{report}" if report else ""))
+            _tm.get()["wd"].inc(new)
+            rec = _flight.get_recorder()
+            rec.note("watchdog_timeout", step=step, total=n)
+            # a deadline overrun is exactly the moment the last-N-steps ring
+            # is worth persisting — the process may be torn down next. Rate-
+            # limited: every overrun is still note()d above, but the full
+            # dump repeats at most once per interval.
+            now = time.monotonic()
+            if (self._last_wd_dump is None
+                    or now - self._last_wd_dump >= _WD_DUMP_MIN_INTERVAL_S):
+                self._last_wd_dump = now
+                rec.dump(reason=f"watchdog deadline overrun at step {step}")
 
     # ------------------------------------------------------------------ #
 
@@ -183,6 +228,7 @@ class ResilientTrainer:
             while not stop.wait(interval):
                 try:
                     self.elastic.heartbeat()
+                    self._last_beat = time.monotonic()
                 except Exception:
                     pass  # store hiccup: the next beat retries
 
@@ -190,10 +236,26 @@ class ResilientTrainer:
         t.start()
         return stop
 
+    def _save(self, step):
+        t0 = time.perf_counter()
+        self.manager.save(self.state(), step)
+        dt = time.perf_counter() - t0
+        _tm.get()["ckpt"].observe(dt)
+        _flight.get_recorder().note("checkpoint_save", step=step,
+                                    latency_s=round(dt, 6))
+
     def run(self, num_steps):
         """Train to `num_steps` total steps (counting completed pre-crash
         progress); returns a summary dict."""
+        recorder = _flight.get_recorder()
+        recorder.snapshot_metrics()  # dump reports deltas from this run
+        # SIGTERM (preemption) + uncaught-exception post-mortems; chained
+        # and idempotent, path from PADDLE_FLIGHT_FILE (set by the launcher)
+        _flight.install_crash_handlers()
         start = self.resume()
+        recorder.note("trainer_start", start_step=start,
+                      resumed_from=self.resumed_from,
+                      restart_count=self.restart_count)
         if self.step_timeout is not None:
             comm_watchdog.enable()
             # only report overruns from THIS run, not a previous trainer's
@@ -207,19 +269,42 @@ class ResilientTrainer:
         try:
             for step in range(start, num_steps):
                 self._wait_ready(step)
+                tl = _spans.active_timeline()
+                if tl is not None:
+                    tl.step_begin(step)
+                t0 = time.perf_counter()
                 with comm_watchdog.comm_task(f"train_step/{step}",
                                              self.step_timeout):
                     # inside the watchdog region: an injected stall here is
                     # exactly a step wedged in a collective
                     faults.fault_point("trainer.before_step")
                     last_loss = self.step_fn(step)
+                tm = _tm.get()
+                tm["step"].observe(time.perf_counter() - t0)
+                if self._last_beat is not None:
+                    tm["hb_age"].set(time.monotonic() - self._last_beat)
                 self._check_watchdog(step)
+                if tl is not None:
+                    tl.step_end(extra={"restart_count": self.restart_count})
                 if (step + 1) % self.save_every == 0:
-                    self.manager.save(self.state(), step)
+                    self._save(step)
                     saved_at = step
             if num_steps > start and saved_at != num_steps - 1:
-                self.manager.save(self.state(), num_steps - 1)
+                self._save(num_steps - 1)
             self.manager.wait()
+        except Exception as e:
+            # the post-mortem the flight recorder exists for: last N step
+            # timelines + metric deltas + watchdog peek, written before the
+            # exception unwinds (SystemExit — the reform path — excluded)
+            tl = _spans.active_timeline()
+            if tl is not None:
+                # the dying step never reached step_end; close it so the
+                # dump's ring includes the step that killed the run
+                tl.step_end(extra={"aborted": True,
+                                   "restart_count": self.restart_count})
+            recorder.dump(reason=f"trainer crash at step {step}: "
+                                 f"{type(e).__name__}: {e}")
+            raise
         finally:
             if hb_stop is not None:
                 hb_stop.set()

@@ -106,13 +106,67 @@ def tracing_guard(flag: bool = True):
 # Interceptor hook point (used by amp autocast, analog of the AMP branch in
 # generated ad_func entry points — reference:
 # paddle/fluid/eager/api/manual/eager_manual/forwards/multiply_fwd_func.cc:49-70).
-# Signature: fn(op_name, values) -> values
+# Signature: fn(op_name, values) -> values.
+#
+# Two registration surfaces share one dispatch slot (`_op_input_interceptor`,
+# read by run_op): the legacy single "base" slot (set_* — amp autocast owns
+# it, save/restore style) and an additive chain (add_*/remove_* — graftlint
+# runtime census, telemetry). The composed slot is rebuilt on any change so
+# the hot path stays a single attribute read + call; enabling amp no longer
+# clobbers chained observers (the pre-chain bug docs/LINTING.md documented).
 _op_input_interceptor: Callable | None = None
+_base_op_input_interceptor: Callable | None = None
+_op_input_interceptor_chain: list = []
+
+
+def _compose_op_input_interceptor():
+    global _op_input_interceptor
+    base, chain = _base_op_input_interceptor, tuple(_op_input_interceptor_chain)
+    if not chain:
+        _op_input_interceptor = base
+        return
+    if base is None and len(chain) == 1:
+        _op_input_interceptor = chain[0]
+        return
+
+    def _dispatch(name, values, _base=base, _chain=chain):
+        if _base is not None:
+            out = _base(name, values)
+            if out is not None:
+                values = out
+        for fn in _chain:
+            out = fn(name, values)
+            if out is not None:
+                values = out
+        return values
+
+    _op_input_interceptor = _dispatch
 
 
 def set_op_input_interceptor(fn):
-    global _op_input_interceptor
-    _op_input_interceptor = fn
+    """Install/replace the base interceptor; returns the previous base so
+    save/restore callers (amp autocast) can chain-restore correctly."""
+    global _base_op_input_interceptor
+    prev = _base_op_input_interceptor
+    _base_op_input_interceptor = fn
+    _compose_op_input_interceptor()
+    return prev
+
+
+def add_op_input_interceptor(fn):
+    """Append `fn` to the interceptor chain (composes with the base slot and
+    every other chained interceptor); returns `fn` for remove_*."""
+    _op_input_interceptor_chain.append(fn)
+    _compose_op_input_interceptor()
+    return fn
+
+
+def remove_op_input_interceptor(fn):
+    try:
+        _op_input_interceptor_chain.remove(fn)
+    except ValueError:
+        pass
+    _compose_op_input_interceptor()
 
 
 # --------------------------------------------------------------------------- #
@@ -890,7 +944,15 @@ _op_recorder: Callable | None = None
 # Called with (kind, tensor) when Python control flow consumes a concrete
 # tensor value (__bool__/__int__/__float__) — the graph-break points the SOT
 # capture (jit/sot.py) segments compiled subgraphs around.
+#
+# Same two-surface model as the op-input interceptor: a base slot (set_* —
+# the SOT capture save/restores it around a recording) plus an additive
+# chain (add_*/remove_* — graftlint runtime sync enforcement, telemetry's
+# StepTimeline). A chained observer returning non-None proposes a
+# replacement value for `item()` (last non-None wins, base first).
 _sync_observer: Callable | None = None
+_base_sync_observer: Callable | None = None
+_sync_observer_chain: list = []
 
 
 def set_op_recorder(fn):
@@ -898,9 +960,51 @@ def set_op_recorder(fn):
     _op_recorder = fn
 
 
-def set_sync_observer(fn):
+def _compose_sync_observer():
     global _sync_observer
-    _sync_observer = fn
+    base, chain = _base_sync_observer, tuple(_sync_observer_chain)
+    if not chain:
+        _sync_observer = base
+        return
+    if base is None and len(chain) == 1:
+        _sync_observer = chain[0]
+        return
+
+    def _dispatch(kind, tensor, _base=base, _chain=chain):
+        rep = _base(kind, tensor) if _base is not None else None
+        for fn in _chain:
+            out = fn(kind, tensor)
+            if out is not None:
+                rep = out
+        return rep
+
+    _sync_observer = _dispatch
+
+
+def set_sync_observer(fn):
+    """Install/replace the base observer; returns the previous base. NEVER
+    read `core._sync_observer` to save state — that is the composed dispatch
+    slot, and re-setting it as a base would double-fire the chain."""
+    global _base_sync_observer
+    prev = _base_sync_observer
+    _base_sync_observer = fn
+    _compose_sync_observer()
+    return prev
+
+
+def add_sync_observer(fn):
+    """Append `fn` to the sync-observer chain; returns `fn` for remove_*."""
+    _sync_observer_chain.append(fn)
+    _compose_sync_observer()
+    return fn
+
+
+def remove_sync_observer(fn):
+    try:
+        _sync_observer_chain.remove(fn)
+    except ValueError:
+        pass
+    _compose_sync_observer()
 
 
 def run_op(name: str, fn: Callable, inputs: Sequence, n_outputs: int | None = None):

@@ -77,11 +77,42 @@ class ProgBarLogger(Callback):
     def __init__(self, log_freq=1, verbose=2):
         self.log_freq = log_freq
         self.verbose = verbose
+        # registry cursor for throughput: (count, sum) of the step-time
+        # histogram at the last log line
+        self._tp_cursor = (0, 0.0)
+
+    def on_train_begin(self, logs=None):
+        # seed from the CURRENT registry state: the histogram is process-
+        # wide, so a second fit() in the same process must not fold the
+        # first fit's steps into its opening ips line
+        from ..observability.metrics import default_registry
+
+        hist = default_registry().get("hapi_train_step_seconds")
+        self._tp_cursor = (hist.count(), hist.sum()) if hist is not None \
+            else (0, 0.0)
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.steps = self.params.get("steps")
         self._start = time.time()
+
+    def _throughput(self):
+        """steps/s since the last log line, read from the metrics registry
+        (hapi_train_step_seconds, written by Model.fit) — the same series
+        telemetry exports, so the progress bar and the step-timeline JSONL
+        cannot disagree. Returns None before fit has recorded a step."""
+        from ..observability.metrics import default_registry
+
+        hist = default_registry().get("hapi_train_step_seconds")
+        if hist is None:
+            return None
+        count, total = hist.count(), hist.sum()
+        c0, s0 = self._tp_cursor
+        self._tp_cursor = (count, total)
+        dc, ds = count - c0, total - s0
+        if dc <= 0 or ds <= 0:
+            return None
+        return dc / ds
 
     def on_train_batch_end(self, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
@@ -89,6 +120,9 @@ class ProgBarLogger(Callback):
                 f"{k}: {v:.4f}" if isinstance(v, (int, float, np.floating)) else f"{k}: {v}"
                 for k, v in (logs or {}).items()
             )
+            ips = self._throughput()
+            if ips is not None:
+                items += f" - ips: {ips:.3f} steps/s"
             total = f"/{self.steps}" if self.steps else ""
             print(f"Epoch {self.epoch}: step {step}{total} - {items}", flush=True)
 

@@ -113,6 +113,11 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
+        import time
+
+        from ..observability import metrics as _obs_metrics
+        from ..observability import spans as _obs_spans
+
         loader = self._to_loader(train_data, batch_size, shuffle, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, num_workers)
         cbs = [ProgBarLogger(log_freq, verbose), LRScheduler()]
@@ -124,6 +129,19 @@ class Model:
             steps = None
         cblist = CallbackList(cbs, model=self, params={"epochs": epochs, "steps": steps, "verbose": verbose})
         self.stop_training = False
+        # the registry is the single source for fit's throughput numbers:
+        # ProgBarLogger derives its ips from hapi_train_step_seconds, so the
+        # progress line and telemetry exports can never disagree
+        reg = _obs_metrics.default_registry()
+        m_step_time = reg.histogram(
+            "hapi_train_step_seconds", "wall time per Model.fit train step")
+        m_steps = reg.counter("hapi_train_steps_total",
+                              "train steps run by Model.fit")
+        m_loss_sync = reg.counter(
+            "hapi_loss_sync_total",
+            "host syncs of the loss scalar inside the fit loop (log "
+            "boundaries + epoch means; anything above log cadence is a "
+            "callback paying for per-step values)")
         cblist.on_train_begin()
         it = 0
         for epoch in range(epochs):
@@ -133,7 +151,12 @@ class Model:
             for step_i, batch in enumerate(loader):
                 cblist.on_train_batch_begin(step_i)
                 inputs, labels = self._split_batch(batch)
-                loss_t = self._train_batch_async(inputs, labels)
+                tl = _obs_spans.active_timeline()
+                if tl is not None:
+                    tl.step_begin(it)
+                t0 = time.perf_counter()
+                with _obs_spans.span("fit/train_batch"):
+                    loss_t = self._train_batch_async(inputs, labels)
                 # device-side running mean: O(1) live buffers and a single
                 # host sync per epoch instead of one blocking float() per
                 # step (which serialized XLA's async dispatch pipeline)
@@ -143,11 +166,24 @@ class Model:
                 # between log points callbacks get the 0-d device Tensor —
                 # float()-able / formattable on demand, so a callback that
                 # *wants* per-step values pays the per-step sync itself
-                loss_v = float(loss_t) if step_i % log_freq == 0 else loss_t
+                will_sync = step_i % log_freq == 0
+                if will_sync:
+                    loss_v = float(loss_t)
+                    m_loss_sync.inc()
+                else:
+                    loss_v = loss_t
+                dt = time.perf_counter() - t0
+                m_step_time.observe(dt)
+                m_steps.inc()
+                if tl is not None:
+                    tl.step_end(extra={"epoch": epoch,
+                                       "loss_synced": will_sync})
                 cblist.on_train_batch_end(step_i, {"loss": loss_v})
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     break
+            if n_steps:
+                m_loss_sync.inc()  # the single per-epoch mean sync
             logs = {"loss": float(loss_sum) / n_steps if n_steps else 0.0}
             cblist.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:

@@ -427,9 +427,11 @@ class SOTCapture:
             return ("const", out)
 
         prev_rec = _core._op_recorder
-        prev_obs = _core._sync_observer
         set_op_recorder(rec)
-        set_sync_observer(observe)
+        # set_* returns the previous BASE observer; reading the composed
+        # _sync_observer slot here would capture (and later re-install as a
+        # base) the add_*-chain dispatcher, double-firing chained observers
+        prev_obs = set_sync_observer(observe)
         try:
             out = self.fn(*args)
         except _SOTUnsupported as _e:

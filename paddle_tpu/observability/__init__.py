@@ -1,0 +1,68 @@
+"""Unified telemetry layer: metrics registry, step timeline, flight recorder.
+
+The framework already produces rich runtime signals — sync-observer and
+op-input-interceptor hooks in `framework.core`, `dispatch_cache_stats()`,
+comm-watchdog reports, elastic heartbeats, checkpoint commit events — but
+until this subsystem they had no common place to be recorded, aggregated, or
+exported. Three pieces (docs/OBSERVABILITY.md):
+
+- `metrics` — process-wide counters/gauges/histograms with labels; lock-free
+  emission, JSONL + Prometheus text exporters.
+- `spans` — nested `span()` context/decorator feeding the profiler's
+  chrome-trace AND the per-step `StepTimeline`, which stitches host spans,
+  `comm_task` intervals, observed host syncs, and dispatch-cache deltas into
+  one structured record per training step (cross-rank aggregation over the
+  TCPStore via `fleet_step_summary`).
+- `flight` — bounded ring of recent step records + metric deltas, dumped to
+  a post-mortem file on crash, watchdog overrun, or SIGTERM.
+"""
+
+from . import flight, metrics, spans
+from .flight import (
+    FlightRecorder,
+    get_recorder,
+    install_crash_handlers,
+    reset_recorder,
+    uninstall_crash_handlers,
+)
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    reset_default_registry,
+)
+from .spans import (
+    StepTimeline,
+    active_timeline,
+    disable_step_timeline,
+    enable_step_timeline,
+    fleet_step_summary,
+    publish_step_record,
+    span,
+)
+
+__all__ = [
+    "metrics",
+    "spans",
+    "flight",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "reset_default_registry",
+    "span",
+    "StepTimeline",
+    "active_timeline",
+    "enable_step_timeline",
+    "disable_step_timeline",
+    "publish_step_record",
+    "fleet_step_summary",
+    "FlightRecorder",
+    "get_recorder",
+    "reset_recorder",
+    "install_crash_handlers",
+    "uninstall_crash_handlers",
+]

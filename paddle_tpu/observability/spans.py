@@ -1,0 +1,341 @@
+"""Nested host spans and the per-step StepTimeline.
+
+`span("fwd")` is both a context manager and a decorator. Every span is
+reported to two sinks:
+
+- the active `profiler.Profiler` record window (cat ``observability``), so
+  spans land on the same chrome-trace timeline as op dispatch events and
+  `RecordEvent` annotations;
+- the installed `StepTimeline` (if any), which stitches spans together with
+  the other per-step signals the framework already produces but previously
+  scattered across four log formats: observed host syncs
+  (`framework.core` sync-observer chain), `comm_watchdog.comm_task`
+  intervals, and eager dispatch-cache hit/miss/bypass deltas.
+
+One `StepTimeline` record per training step is the unit the flight recorder
+buffers and the JSONL exporter appends — see docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "span",
+    "StepTimeline",
+    "active_timeline",
+    "enable_step_timeline",
+    "disable_step_timeline",
+    "publish_step_record",
+    "fleet_step_summary",
+]
+
+_tls = threading.local()
+
+
+def _span_stack() -> list:
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    return stack
+
+
+class span:
+    """`with span("fwd"): ...` or `@span("fwd")`. Nesting is tracked per
+    thread; the reported name is the slash-joined path ("step/fwd/attn")."""
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._t0 = None
+        self._path = None
+
+    def __enter__(self):
+        stack = _span_stack()
+        self._path = "/".join([s._path for s in stack[-1:]] + [self.name]) \
+            if stack else self.name
+        stack.append(self)
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        stack = _span_stack()
+        depth = len(stack) - 1
+        if stack and stack[-1] is self:
+            stack.pop()
+        _emit_span(self._path or self.name, self._t0, t1, depth, self.attrs)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with span(self.name, **self.attrs):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def _emit_span(path, t0_ns, t1_ns, depth, attrs):
+    # profiler sink: only while a record window is open
+    from ..profiler import profiler as _prof_mod
+
+    prof = _prof_mod._active_profiler
+    if prof is not None and prof._recording:
+        prof._add_event(path, t0_ns, t1_ns, cat="observability")
+    tl = _active_timeline
+    if tl is not None:
+        tl._on_span(path, t0_ns, t1_ns, depth, attrs)
+
+
+# --------------------------------------------------------------------------- #
+# StepTimeline
+# --------------------------------------------------------------------------- #
+
+_active_timeline: "StepTimeline | None" = None
+
+
+def active_timeline() -> "StepTimeline | None":
+    return _active_timeline
+
+
+class StepTimeline:
+    """Stitch one structured record per training step.
+
+    Install it (`enable_step_timeline()` or `.install()`), then have the
+    step driver — `hapi.Model.fit`, `ResilientTrainer`, `bench.py
+    --emit-metrics` — call `step_begin(i)` / `step_end()`. Everything else
+    is collected passively through chained hooks:
+
+    - host syncs via `framework.core.add_sync_observer` (composes with the
+      graftlint runtime checks — neither clobbers the other);
+    - `comm_task` intervals via `comm_watchdog.add_task_observer`;
+    - spans via the module-level `span` sink;
+    - dispatch-cache hit/miss/bypass deltas snapshotted at the step edges.
+
+    Records land in a bounded deque (the flight recorder's source), and
+    optionally as one JSON line per step in `jsonl_path`.
+    """
+
+    def __init__(self, jsonl_path: str | None = None, keep: int = 512,
+                 max_spans_per_step: int = 256):
+        self.jsonl_path = jsonl_path
+        self.records: deque = deque(maxlen=keep)
+        self.max_spans_per_step = max_spans_per_step
+        self.interstep_syncs = 0
+        self._installed = False
+        self._cur = None  # in-progress step dict
+        self._dropped_spans = 0
+        # running total over CLOSED steps — the bounded ring evicts old
+        # records, so summing it would undercount on runs longer than `keep`
+        self._closed_step_syncs = 0
+
+    # -- hook plumbing --------------------------------------------------- #
+
+    def install(self) -> "StepTimeline":
+        global _active_timeline
+        if self._installed:
+            return self
+        from ..distributed import comm_watchdog
+        from ..framework import core
+
+        if _active_timeline is not None:
+            _active_timeline.uninstall()
+        core.add_sync_observer(self._on_sync)
+        comm_watchdog.add_task_observer(self._on_comm_task)
+        self._installed = True
+        _active_timeline = self
+        return self
+
+    def uninstall(self):
+        global _active_timeline
+        if not self._installed:
+            return
+        from ..distributed import comm_watchdog
+        from ..framework import core
+
+        core.remove_sync_observer(self._on_sync)
+        comm_watchdog.remove_task_observer(self._on_comm_task)
+        self._installed = False
+        if _active_timeline is self:
+            _active_timeline = None
+
+    # -- passive collectors ---------------------------------------------- #
+
+    def _on_sync(self, kind, tensor):
+        cur = self._cur
+        if cur is None:
+            self.interstep_syncs += 1
+        else:
+            cur["host_syncs"] += 1
+            kinds = cur["sync_kinds"]
+            kinds[kind] = kinds.get(kind, 0) + 1
+        return None  # never replace the synced value
+
+    def _on_comm_task(self, desc, t0_ns, t1_ns):
+        cur = self._cur
+        if cur is not None:
+            cur["comm_tasks"].append(
+                {"desc": desc, "dur_s": round((t1_ns - t0_ns) / 1e9, 6)})
+
+    def _on_span(self, path, t0_ns, t1_ns, depth, attrs):
+        cur = self._cur
+        if cur is None:
+            return
+        if len(cur["spans"]) >= self.max_spans_per_step:
+            self._dropped_spans += 1
+            return
+        rec = {"name": path, "depth": depth,
+               "start_ns": t0_ns - cur["_t0_ns"],
+               "dur_s": round((t1_ns - t0_ns) / 1e9, 6)}
+        if attrs:
+            rec["attrs"] = dict(attrs)
+        cur["spans"].append(rec)
+
+    # -- step boundaries -------------------------------------------------- #
+
+    def step_begin(self, step: int):
+        if self._cur is not None:
+            # driver skipped an end (exception path): close what we have
+            self.step_end()
+        from ..framework import core
+
+        self._cur = {
+            "step": int(step),
+            "t_wall": time.time(),
+            "_t0_ns": time.perf_counter_ns(),
+            "host_syncs": 0,
+            "sync_kinds": {},
+            "comm_tasks": [],
+            "spans": [],
+            "_dispatch0": core.dispatch_cache_stats(),
+        }
+
+    def step_end(self, extra: dict | None = None) -> dict | None:
+        cur, self._cur = self._cur, None
+        if cur is None:
+            return None
+        from ..framework import core
+
+        t1 = time.perf_counter_ns()
+        d0 = cur.pop("_dispatch0")
+        d1 = core.dispatch_cache_stats()
+        record = {
+            "step": cur["step"],
+            "t_wall": round(cur["t_wall"], 6),
+            "dur_s": round((t1 - cur.pop("_t0_ns")) / 1e9, 6),
+            "host_syncs": cur["host_syncs"],
+            "sync_kinds": cur["sync_kinds"],
+            "comm_tasks": cur["comm_tasks"],
+            "spans": cur["spans"],
+            "dispatch": {k: d1[k] - d0[k]
+                         for k in ("hits", "misses", "bypass")},
+        }
+        if extra:
+            record.update(extra)
+        self._closed_step_syncs += record["host_syncs"]
+        self.records.append(record)
+        if self.jsonl_path:
+            # default=repr: span attrs / extra are user-fed (numpy scalars
+            # included) and must never abort the training step over a
+            # serialization TypeError
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(record, sort_keys=True, default=repr)
+                        + "\n")
+        from . import flight
+
+        flight.feed_step(record)
+        return record
+
+    # -- reading ---------------------------------------------------------- #
+
+    def total_host_syncs(self) -> int:
+        """Every sync observed since install: closed steps + between-step +
+        the in-progress step (the number the graftlint runtime report's
+        `host_syncs_total` must agree with on the same run, even after the
+        ring has evicted early records)."""
+        n = self.interstep_syncs + self._closed_step_syncs
+        if self._cur is not None:
+            n += self._cur["host_syncs"]
+        return n
+
+
+def enable_step_timeline(jsonl_path: str | None = None, keep: int = 512
+                         ) -> StepTimeline:
+    """Create + install a StepTimeline (replacing any active one)."""
+    return StepTimeline(jsonl_path=jsonl_path, keep=keep).install()
+
+
+def disable_step_timeline():
+    if _active_timeline is not None:
+        _active_timeline.uninstall()
+
+
+# --------------------------------------------------------------------------- #
+# cross-rank aggregation over the rendezvous store
+# --------------------------------------------------------------------------- #
+
+
+def publish_step_record(store, rank: int, record: dict,
+                        prefix: str = "telemetry"):
+    """Every rank publishes its step record; any TCPStore-shaped object
+    (set/get/tryget) works, including the fleet's rendezvous store."""
+    store.set(f"{prefix}/step{record['step']}/rank{rank}",
+              json.dumps(record, sort_keys=True, default=repr))
+
+
+def fleet_step_summary(store, world_size: int, step: int,
+                       prefix: str = "telemetry", timeout: float = 30.0
+                       ) -> dict:
+    """Rank 0 gathers every rank's record for `step` and reduces it to one
+    fleet line: step-time spread (the straggler signal the TPU concurrency
+    study attributes scaling losses to), total host syncs, total comm time."""
+    recs = []
+    deadline = time.monotonic() + timeout
+    for r in range(world_size):
+        key = f"{prefix}/step{step}/rank{r}"
+        raw = None
+        tryget = getattr(store, "tryget", None)
+        while raw is None:
+            if tryget is not None:
+                raw = tryget(key)
+            else:
+                # get-only stores: poll through absent-key errors so the
+                # deadline still applies. (A get() that BLOCKS internally
+                # is outside this contract — TCPStore exposes tryget for
+                # exactly this reason.)
+                try:
+                    raw = store.get(key)
+                except (KeyError, RuntimeError):
+                    raw = None  # absent key: retry until the deadline
+            if raw is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet_step_summary: rank {r} never published "
+                        f"{key} within {timeout}s")
+                time.sleep(0.02)
+        recs.append(json.loads(raw))
+    durs = [rec["dur_s"] for rec in recs]
+    slowest = max(range(world_size), key=lambda i: durs[i])
+    return {
+        "step": step,
+        "ranks": world_size,
+        "step_time_s": {
+            "min": min(durs),
+            "max": max(durs),
+            "mean": sum(durs) / len(durs),
+        },
+        "straggler_rank": slowest,
+        "host_syncs": sum(rec["host_syncs"] for rec in recs),
+        "comm_task_s": round(sum(t["dur_s"] for rec in recs
+                                 for t in rec["comm_tasks"]), 6),
+        "dispatch": {
+            k: sum(rec["dispatch"][k] for rec in recs)
+            for k in ("hits", "misses", "bypass")
+        },
+    }

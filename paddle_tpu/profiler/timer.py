@@ -48,15 +48,33 @@ class Benchmark:
     def after_reader(self):
         if not self._enabled or self._batch_start is None:
             return
-        self.reader.add(time.perf_counter() - self._batch_start)
+        dt = time.perf_counter() - self._batch_start
+        self.reader.add(dt)
+        self._metric().observe(dt, phase="reader")
 
     def after_step(self, num_samples=None):
         if not self._enabled or self._batch_start is None:
             return
         now = time.perf_counter()
-        self.batch.add(now - self._batch_start)
+        dt = now - self._batch_start
+        self.batch.add(dt)
+        self._metric().observe(dt, phase="batch")
         self.num_samples = num_samples
         self._batch_start = now
+
+    def _metric(self):
+        """Mirror every window sample into the observability registry so the
+        timer's step_info and telemetry exports read the same data (handle
+        cached per registry instance — see metrics.HandleCache)."""
+        cache = getattr(self, "_metric_cache", None)
+        if cache is None:
+            from ..observability.metrics import HandleCache
+
+            cache = self._metric_cache = HandleCache(
+                lambda reg: reg.histogram(
+                    "benchmark_cost_seconds",
+                    "timer.Benchmark reader/batch costs", ("phase",)))
+        return cache.get()
 
     def end(self):
         self._enabled = False

@@ -33,6 +33,16 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _flight_file_in_tmp(tmp_path, monkeypatch):
+    """The flight recorder's default dump path is the cwd (production: the
+    launcher points it at the worker log dir). Tests that legitimately
+    crash a trainer (hold timeout, injected faults) must not litter the
+    repo root — default every test's post-mortems into its tmp dir."""
+    monkeypatch.setenv("PADDLE_FLIGHT_FILE",
+                       str(tmp_path / "flight_recorder.json"))
+
+
 @pytest.fixture
 def fault_injector(monkeypatch):
     """Resilience fault harness (tools/fault_inject.py + distributed/faults):

@@ -704,7 +704,8 @@ class TestSelfLint:
         assert stale == {}, f"stale baseline entries: {stale}"
 
     def test_every_rule_registered(self):
-        assert list(RULES) == ["GL001", "GL002", "GL003", "GL004", "GL005"]
+        assert list(RULES) == ["GL001", "GL002", "GL003", "GL004", "GL005",
+                               "GL006"]
 
 
 # --------------------------------------------------------------------------- #
@@ -837,3 +838,85 @@ class TestRuntimeCrossCheck:
         finally:
             rt.uninstall_runtime_checks()
             rt.reset_runtime_events()
+
+
+# --------------------------------------------------------------------------- #
+# GL006 unlabeled hot-path metric
+# --------------------------------------------------------------------------- #
+
+
+class TestGL006:
+    def test_emission_in_jitted_fn(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x, STEP_TOTAL, LAT):
+                STEP_TOTAL.inc()
+                LAT.observe(0.1)
+                return x * 2
+        """, rules=["GL006"])
+        assert rule_ids(fs) == ["GL006", "GL006"]
+        assert ".inc()" in fs[0].message
+        assert "host callback" in fs[0].message
+
+    def test_metricish_set_in_tracing_guard(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            def run(fn, x, hb_gauge):
+                with tracing_guard(True):
+                    out = fn(x)
+                    hb_gauge.set(1.0)
+                return out
+        """, rules=["GL006"])
+        assert rule_ids(fs) == ["GL006"]
+
+    def test_transitive_callee_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            def bump(counter):
+                counter.inc(1, op="fwd")
+
+            def loss(x, counter):
+                bump(counter)
+                return x.sum()
+
+            grad_fn = jax.grad(loss)
+        """, rules=["GL006"])
+        assert rule_ids(fs) == ["GL006"]
+        assert "bump" in fs[0].message
+
+    def test_stdlib_set_add_and_eager_emission_not_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x, seen, cfg):
+                seen.add(3)          # builtin set, not a metric
+                cfg.set("k", "v")    # non-metric receiver
+                return x * 2
+
+            def eager_loop(STEP_TOTAL, LAT):
+                # emission OUTSIDE any traced region is the sanctioned
+                # pattern (the fit loop / StepTimeline.step_end)
+                STEP_TOTAL.inc()
+                LAT.observe(0.5)
+        """, rules=["GL006"])
+        assert fs == []
+
+    def test_suppression_comment(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x, C):
+                C.inc()  # graftlint: disable=GL006 trace-time once is intended
+                return x
+        """, rules=["GL006"])
+        assert fs == []
+
+    def test_repo_hot_paths_stay_clean(self):
+        """The shipped emitters (fit loop, collectives, trainer, timer) all
+        emit outside traces — GL006 over the package must not regress."""
+        fs = lint_paths([REPO / "paddle_tpu"], root=REPO, rules=["GL006"])
+        assert fs == []

@@ -1,0 +1,556 @@
+"""Unified telemetry layer tests: metrics registry semantics, exporter
+golden formats, span nesting + chrome-trace round-trip, StepTimeline
+stitching, chained-hook coexistence with the graftlint runtime, flight
+recorder post-mortems (incl. dump-on-injected-crash through the fault
+harness), and the Model.fit acceptance run where the step-timeline JSONL
+sync counts must agree with the graftlint runtime report."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import comm_watchdog
+from paddle_tpu.framework import core
+from paddle_tpu.observability import flight, metrics, spans
+
+
+@pytest.fixture
+def registry():
+    reg = metrics.reset_default_registry()
+    yield reg
+    metrics.reset_default_registry()
+
+
+@pytest.fixture
+def recorder():
+    rec = flight.reset_recorder()
+    yield rec
+    flight.reset_recorder()
+    flight.uninstall_crash_handlers()
+
+
+@pytest.fixture
+def timeline(registry):
+    tl = obs.enable_step_timeline()
+    yield tl
+    tl.uninstall()
+
+
+# --------------------------------------------------------------------------- #
+# registry semantics
+# --------------------------------------------------------------------------- #
+
+
+class TestRegistry:
+    def test_counter_labels_and_monotonicity(self, registry):
+        c = registry.counter("req_total", "requests", ("op",))
+        c.inc(op="a")
+        c.inc(2.5, op="a")
+        c.inc(op="b")
+        assert c.value(op="a") == 3.5
+        assert c.value(op="b") == 1.0
+        with pytest.raises(ValueError):
+            c.inc(-1, op="a")
+        with pytest.raises(ValueError):
+            c.inc(op="a", extra="nope")  # undeclared label
+
+    def test_gauge_set_inc_dec(self, registry):
+        g = registry.gauge("depth")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value() == 3.0
+
+    def test_histogram_buckets_sum_count_mean(self, registry):
+        h = registry.histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count() == 5
+        assert h.sum() == pytest.approx(56.05)
+        assert h.mean() == pytest.approx(56.05 / 5)
+        sample = [s for s in registry.collect() if s["metric"] == "lat"][0]
+        # per-bucket (non-cumulative) counts as collected
+        assert sample["buckets"] == {"0.1": 1, "1.0": 2, "10.0": 1}
+        assert sample["count"] == 5  # 50.0 overflows to +Inf only
+
+    def test_redeclare_same_family_ok_mismatch_rejected(self, registry):
+        c1 = registry.counter("x_total", "x", ("op",))
+        c2 = registry.counter("x_total", "x", ("op",))
+        assert c1 is c2
+        with pytest.raises(ValueError):
+            registry.gauge("x_total")
+        with pytest.raises(ValueError):
+            registry.counter("x_total", labelnames=("other",))
+        h1 = registry.histogram("h", buckets=(1.0, 2.0))
+        assert registry.histogram("h", buckets=(2.0, 1.0)) is h1  # same set
+        with pytest.raises(ValueError):
+            registry.histogram("h", buckets=(0.5, 2.0))
+
+    def test_snapshot_delta(self, registry):
+        c = registry.counter("n_total")
+        g = registry.gauge("g")
+        c.inc(3)
+        g.set(7)
+        snap = registry.snapshot()
+        c.inc(2)
+        g.set(1)
+        d = registry.delta(snap)
+        assert d["n_total"] == 2
+        assert d["g"] == 1  # gauges report current value, not a diff
+
+
+# --------------------------------------------------------------------------- #
+# exporters (golden formats)
+# --------------------------------------------------------------------------- #
+
+
+class TestExporters:
+    def _fill(self, reg):
+        c = reg.counter("rpc_total", "rpc calls", ("op",))
+        c.inc(3, op="all_reduce")
+        g = reg.gauge("queue_depth")
+        g.set(2)
+        h = reg.histogram("step_seconds", "per-step", buckets=(0.5, 2.0))
+        h.observe(0.25)
+        h.observe(1.0)
+        h.observe(9.0)
+
+    def test_prometheus_text_golden(self, registry):
+        self._fill(registry)
+        assert registry.prometheus_text() == (
+            "# HELP rpc_total rpc calls\n"
+            "# TYPE rpc_total counter\n"
+            'rpc_total{op="all_reduce"} 3\n'
+            "# TYPE queue_depth gauge\n"
+            "queue_depth 2\n"
+            "# HELP step_seconds per-step\n"
+            "# TYPE step_seconds histogram\n"
+            'step_seconds_bucket{le="0.5"} 1\n'
+            'step_seconds_bucket{le="2"} 2\n'      # cumulative
+            'step_seconds_bucket{le="+Inf"} 3\n'
+            "step_seconds_sum 10.25\n"
+            "step_seconds_count 3\n"
+        )
+
+    def test_jsonl_events_golden(self, registry, tmp_path):
+        self._fill(registry)
+        lines = registry.jsonl_events(ts=0)
+        docs = [json.loads(ln) for ln in lines]
+        assert docs[0] == {"ts": 0, "metric": "rpc_total", "type": "counter",
+                           "labels": {"op": "all_reduce"}, "value": 3}
+        hist = [d for d in docs if d["metric"] == "step_seconds"][0]
+        assert hist["count"] == 3 and hist["sum"] == 10.25
+        assert hist["buckets"] == {"0.5": 1, "2.0": 1}
+        # file export appends parseable lines
+        path = tmp_path / "m.jsonl"
+        registry.export_jsonl(str(path), ts=0)
+        registry.export_jsonl(str(path), ts=1)
+        on_disk = [json.loads(ln) for ln in path.read_text().splitlines()]
+        assert len(on_disk) == 2 * len(docs)
+
+
+# --------------------------------------------------------------------------- #
+# spans
+# --------------------------------------------------------------------------- #
+
+
+class TestSpans:
+    def test_nesting_paths_and_decorator(self, timeline):
+        @obs.span("inner_fn")
+        def work():
+            return 1
+
+        timeline.step_begin(0)
+        with obs.span("fwd"):
+            with obs.span("attn"):
+                pass
+            work()
+        rec = timeline.step_end()
+        names = [(s["name"], s["depth"]) for s in rec["spans"]]
+        # children close before parents (exit order)
+        assert ("fwd/attn", 1) in names
+        assert ("fwd/inner_fn", 1) in names
+        assert ("fwd", 0) in names
+        assert all(s["dur_s"] >= 0 for s in rec["spans"])
+
+    def test_chrome_trace_round_trip(self, tmp_path):
+        from paddle_tpu.profiler import Profiler
+
+        p = Profiler()
+        p.start()
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with obs.span("obs_step"):
+            with obs.span("obs_fwd"):
+                _ = (x + x).sum()
+        p.stop()
+        path = str(tmp_path / "trace.json")
+        p.export(path)
+        doc = json.load(open(path))
+        byname = {e["name"]: e for e in doc["traceEvents"]}
+        assert byname["obs_step"]["cat"] == "observability"
+        assert "obs_step/obs_fwd" in byname
+        # spans share the timeline with op dispatch events
+        assert any(e["cat"] == "operator" for e in doc["traceEvents"])
+
+
+# --------------------------------------------------------------------------- #
+# StepTimeline stitching
+# --------------------------------------------------------------------------- #
+
+
+class TestStepTimeline:
+    def test_stitches_syncs_comm_tasks_dispatch(self, timeline):
+        x = paddle.to_tensor(np.ones((8,), np.float32))
+        timeline.step_begin(7)
+        with obs.span("fwd"):
+            y = (x * 2.0).sum()
+        with comm_watchdog.comm_task("allreduce/7"):
+            time.sleep(0.01)
+        _ = float(y)      # sync 1
+        _ = y.numpy()     # sync 2
+        rec = timeline.step_end(extra={"loss": 1.0})
+
+        assert rec["step"] == 7 and rec["loss"] == 1.0
+        assert rec["host_syncs"] == 2
+        assert rec["sync_kinds"] == {"float": 1, "array": 1}
+        assert [t["desc"] for t in rec["comm_tasks"]] == ["allreduce/7"]
+        assert rec["comm_tasks"][0]["dur_s"] >= 0.01
+        # ops ran through the eager dispatch cache during the step
+        d = rec["dispatch"]
+        assert d["hits"] + d["misses"] + d["bypass"] >= 2
+        assert rec["dur_s"] > 0
+        assert timeline.records[-1] is rec
+
+    def test_interstep_syncs_and_totals(self, timeline):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        timeline.step_begin(0)
+        _ = float(x.sum())
+        timeline.step_end()
+        _ = float(x.sum())  # between steps
+        timeline.step_begin(1)
+        timeline.step_end()
+        assert timeline.interstep_syncs == 1
+        assert timeline.total_host_syncs() == 2
+
+    def test_total_syncs_survive_ring_eviction(self, registry):
+        tl = spans.StepTimeline(keep=2).install()
+        try:
+            x = paddle.to_tensor(np.ones((2,), np.float32))
+            for i in range(5):
+                tl.step_begin(i)
+                _ = float(x.sum())
+                tl.step_end()
+        finally:
+            tl.uninstall()
+        assert len(tl.records) == 2  # ring evicted steps 0-2...
+        assert tl.total_host_syncs() == 5  # ...but the total kept counting
+
+    def test_jsonl_output(self, registry, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        tl = obs.enable_step_timeline(jsonl_path=path)
+        try:
+            for i in range(3):
+                tl.step_begin(i)
+                tl.step_end()
+        finally:
+            tl.uninstall()
+        recs = [json.loads(ln) for ln in open(path)]
+        assert [r["step"] for r in recs] == [0, 1, 2]
+
+    def test_fleet_summary_over_store(self, registry):
+        class FakeStore:
+            def __init__(self):
+                self.kv = {}
+
+            def set(self, k, v):
+                self.kv[k] = v.encode() if isinstance(v, str) else v
+
+            def tryget(self, k):
+                return self.kv.get(k)
+
+        store = FakeStore()
+        base = {"sync_kinds": {}, "comm_tasks": [], "spans": [],
+                "dispatch": {"hits": 4, "misses": 1, "bypass": 0},
+                "t_wall": 0.0}
+        obs.publish_step_record(
+            store, 0, {**base, "step": 3, "dur_s": 0.10, "host_syncs": 1})
+        obs.publish_step_record(
+            store, 1, {**base, "step": 3, "dur_s": 0.30, "host_syncs": 2,
+                       "comm_tasks": [{"desc": "ar", "dur_s": 0.05}]})
+        s = obs.fleet_step_summary(store, world_size=2, step=3)
+        assert s["ranks"] == 2 and s["step"] == 3
+        assert s["step_time_s"]["max"] == 0.30
+        assert s["step_time_s"]["mean"] == pytest.approx(0.20)
+        assert s["straggler_rank"] == 1
+        assert s["host_syncs"] == 3
+        assert s["comm_task_s"] == pytest.approx(0.05)
+        assert s["dispatch"]["hits"] == 8
+
+    def test_fleet_summary_times_out_on_missing_rank(self, registry):
+        class EmptyStore:
+            def tryget(self, k):
+                return None
+
+        with pytest.raises(TimeoutError):
+            obs.fleet_step_summary(EmptyStore(), world_size=1, step=0,
+                                   timeout=0.05)
+
+
+# --------------------------------------------------------------------------- #
+# chained hooks + graftlint runtime coexistence
+# --------------------------------------------------------------------------- #
+
+
+class TestChainedHooks:
+    def test_set_returns_previous_base(self):
+        prev0 = core.set_sync_observer(None)
+        try:
+            a = lambda k, t: None  # noqa: E731
+            assert core.set_sync_observer(a) is None
+            assert core.set_sync_observer(None) is a
+        finally:
+            core.set_sync_observer(prev0)
+
+    def test_add_remove_compose_with_base(self):
+        seen = []
+        prev0 = core.set_sync_observer(lambda k, t: seen.append(("base", k)))
+        obs_fn = core.add_sync_observer(lambda k, t: seen.append(("chain", k)))
+        try:
+            x = paddle.to_tensor(np.ones((2,), np.float32))
+            _ = float(x.sum())
+            assert ("base", "float") in seen and ("chain", "float") in seen
+        finally:
+            core.remove_sync_observer(obs_fn)
+            core.set_sync_observer(prev0)
+
+    def test_interceptor_chain_composes_with_base(self):
+        calls = []
+        prev0 = core.set_op_input_interceptor(None)
+        icp = core.add_op_input_interceptor(
+            lambda name, values: calls.append(name) or values)
+        try:
+            x = paddle.to_tensor(np.ones((2,), np.float32))
+            _ = x + x
+            assert "add" in calls
+        finally:
+            core.remove_op_input_interceptor(icp)
+            core.set_op_input_interceptor(prev0)
+
+    def test_graftlint_runtime_and_timeline_coexist(self, registry):
+        """GRAFTLINT_RUNTIME=1 semantics + telemetry together: the runtime
+        check still raises on an in-trace sync, the timeline still counts
+        every sync, and uninstalling either leaves the other working."""
+        from tools.graftlint import runtime as rt
+
+        rt.install_runtime_checks("raise")
+        tl = obs.enable_step_timeline()
+        rt.reset_runtime_events()
+        try:
+            x = paddle.to_tensor(np.ones((3,), np.float32))
+            tl.step_begin(0)
+            _ = float(x.sum())  # eager sync: allowed, counted by both
+            rec = tl.step_end()
+            assert rec["host_syncs"] == 1
+            assert rt.runtime_report()["host_syncs_total"] == 1
+
+            with pytest.raises(rt.HostSyncInTraceError):
+                with core.tracing_guard(True):
+                    x.numpy()
+            assert len(rt.runtime_report()["host_syncs_in_trace"]) == 1
+
+            # removing the runtime checks must not detach the timeline
+            rt.uninstall_runtime_checks()
+            tl.step_begin(1)
+            _ = float(x.sum())
+            assert tl.step_end()["host_syncs"] == 1
+        finally:
+            rt.uninstall_runtime_checks()
+            rt.reset_runtime_events()
+            tl.uninstall()
+
+
+# --------------------------------------------------------------------------- #
+# watchdog report: peek vs drain
+# --------------------------------------------------------------------------- #
+
+
+class TestWatchdogReport:
+    def test_peek_is_non_destructive_drain_consumes_once(self):
+        comm_watchdog.disable()
+        if not comm_watchdog.enable(timeout_seconds=5.0):
+            pytest.skip("native watchdog unavailable")
+        try:
+            with comm_watchdog.comm_task("stuck/1", 0.1):
+                time.sleep(0.4)
+            deadline = time.time() + 3
+            while time.time() < deadline and not comm_watchdog.peek_report():
+                time.sleep(0.05)
+            first_peek = comm_watchdog.peek_report()
+            assert "stuck/1" in first_peek
+            # peek again: unchanged (non-destructive)
+            assert comm_watchdog.peek_report() == first_peek
+            # drain hands out the text once...
+            assert "stuck/1" in comm_watchdog.drain_report()
+            assert comm_watchdog.drain_report() == ""
+            # ...but peek still sees the retained history
+            assert "stuck/1" in comm_watchdog.peek_report()
+
+            events = comm_watchdog.report_events()
+            assert events and events[0]["desc"] == "stuck/1"
+            assert events[0]["timeout_ms"] == 100
+            assert events[0]["elapsed_ms"] >= 100
+        finally:
+            comm_watchdog.disable()
+
+
+# --------------------------------------------------------------------------- #
+# flight recorder
+# --------------------------------------------------------------------------- #
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_dump_contents(self, registry, recorder,
+                                            tmp_path):
+        rec = flight.FlightRecorder(capacity=3)
+        for i in range(5):
+            rec.record_step({"step": i, "dur_s": 0.01, "host_syncs": 0})
+        assert [s["step"] for s in rec.steps] == [2, 3, 4]
+        rec.note("checkpoint_save", step=4)
+        registry.counter("c_total").inc(2)
+        path = str(tmp_path / "fl.json")
+        out = rec.dump(path, reason="unit test")
+        assert out == path
+        doc = json.loads(open(path).read().splitlines()[-1])
+        assert doc["reason"] == "unit test"
+        assert [s["step"] for s in doc["steps"]] == [2, 3, 4]
+        assert doc["events"][0]["kind"] == "checkpoint_save"
+        assert doc["metric_deltas"]["c_total"] == 2
+        assert "watchdog_report" in doc and "dispatch_cache" in doc
+
+    def test_timeline_feeds_default_recorder(self, registry, recorder):
+        tl = obs.enable_step_timeline()
+        try:
+            tl.step_begin(11)
+            tl.step_end()
+        finally:
+            tl.uninstall()
+        assert [s["step"] for s in recorder.steps] == [11]
+
+    def test_dump_on_injected_crash(self, registry, recorder, tmp_path,
+                                    monkeypatch, fault_injector):
+        """The acceptance path: ResilientTrainer + armed fault point → the
+        flight recorder post-mortem lands on disk with the dying step."""
+        from paddle_tpu.distributed.faults import FaultInjected
+        from paddle_tpu.distributed.resilience import ResilientTrainer
+
+        fl_path = str(tmp_path / "worker.flight")
+        monkeypatch.setenv("PADDLE_FLIGHT_FILE", fl_path)
+        tl = obs.enable_step_timeline()
+        w = paddle.to_tensor(np.zeros(4, np.float32))
+
+        def step_fn(i):
+            w.set_value(paddle.to_tensor(w.numpy() + 1.0))
+            return float(w.numpy()[0])
+
+        fault_injector.arm("trainer.before_step", "exc", nth=3)
+        try:
+            with pytest.raises(FaultInjected):
+                ResilientTrainer(step_fn, {"w": w}, str(tmp_path / "ck"),
+                                 save_every=2, async_save=False).run(6)
+        finally:
+            fault_injector.disarm()
+            tl.uninstall()
+            flight.uninstall_crash_handlers()
+        doc = json.loads(open(fl_path).read().splitlines()[-1])
+        assert "trainer crash at step 2" in doc["reason"]
+        steps = [s["step"] for s in doc["steps"]]
+        assert steps[-1] == 2  # the aborted step made it into the ring
+        assert doc["steps"][-1].get("aborted") is True
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "trainer_start" in kinds and "checkpoint_save" in kinds
+        # trainer metrics made it into the dump's delta window
+        assert any(k.startswith("trainer_step_seconds")
+                   for k in doc["metric_deltas"])
+
+    def test_sigterm_handler_chains_and_uninstalls(self, registry, recorder,
+                                                   tmp_path):
+        import signal
+
+        calls = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: calls.append(s))
+        try:
+            path = str(tmp_path / "sig.flight")
+            flight.install_crash_handlers(path)
+            os.kill(os.getpid(), signal.SIGTERM)
+            # give the interpreter a bytecode boundary to run the handler
+            time.sleep(0.01)
+            assert calls == [signal.SIGTERM]  # previous handler still ran
+            doc = json.loads(open(path).read().splitlines()[-1])
+            assert doc["reason"] == "SIGTERM"
+        finally:
+            flight.uninstall_crash_handlers()
+            signal.signal(signal.SIGTERM, prev)
+
+
+# --------------------------------------------------------------------------- #
+# acceptance: Model.fit telemetry agrees with the graftlint runtime
+# --------------------------------------------------------------------------- #
+
+
+class TestFitTelemetry:
+    def test_fit_jsonl_sync_counts_match_graftlint_runtime(self, registry,
+                                                           tmp_path):
+        """Single-process Model.fit with telemetry enabled: the JSONL step
+        timeline's host-sync counts must agree with the graftlint runtime
+        report for the same run — two independent observers on one chained
+        hook, so a disagreement means a dropped observer."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from tools.graftlint import runtime as rt
+
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(opt.SGD(learning_rate=0.01,
+                              parameters=net.parameters()),
+                      nn.MSELoss())
+        rng = np.random.default_rng(0)
+        data = [(rng.random((2, 4), np.float32).astype(np.float32),
+                 rng.random((2, 2)).astype(np.float32)) for _ in range(6)]
+
+        path = str(tmp_path / "fit_steps.jsonl")
+        tl = obs.enable_step_timeline(jsonl_path=path)
+        rt.install_runtime_checks("raise")  # fit must not sync under traces
+        rt.reset_runtime_events()
+        try:
+            model.fit(data, epochs=1, log_freq=2, verbose=0)
+        finally:
+            rt.uninstall_runtime_checks()
+            tl.uninstall()
+
+        recs = [json.loads(ln) for ln in open(path)]
+        assert len(recs) == 6
+        # the loss scalar syncs exactly at log boundaries (steps 0, 2, 4)
+        assert [r["host_syncs"] for r in recs] == [1, 0, 1, 0, 1, 0]
+        assert [r["loss_synced"] for r in recs] == \
+            [True, False, True, False, True, False]
+        # per-step counts + between-step syncs (the epoch-mean float) must
+        # equal what the graftlint runtime observer saw on the same run
+        rep = rt.runtime_report()
+        assert rep["host_syncs_in_trace"] == []
+        total_from_timeline = (sum(r["host_syncs"] for r in recs)
+                               + tl.interstep_syncs)
+        assert total_from_timeline == rep["host_syncs_total"]
+        assert tl.total_host_syncs() == rep["host_syncs_total"]
+
+        # the registry's view agrees with the timeline's sync accounting
+        assert registry.get("hapi_loss_sync_total").value() == 4  # 3 logs + epoch mean
+        assert registry.get("hapi_train_steps_total").value() == 6
+        assert registry.get("hapi_train_step_seconds").count() == 6
+        # fit's spans are in the step records
+        assert recs[0]["spans"][0]["name"] == "fit/train_batch"
+        rt.reset_runtime_events()

@@ -185,3 +185,68 @@ def test_device_kernel_summary_from_trace(tmp_path):
     p._events = []
     s = p.summary()
     assert "Kernel Summary" in s
+
+
+def test_make_scheduler_repeat_zero_wraps_forever():
+    """repeat=0 cycles indefinitely: the pattern at steps [0, period) must
+    repeat verbatim at [k*period, (k+1)*period) for any k — no CLOSED
+    tail-off like the exhausted-repeat case."""
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=0)
+    period = [sched(i) for i in range(4)]
+    assert period == [
+        ProfilerState.CLOSED,
+        ProfilerState.READY,
+        ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN,
+    ]
+    for k in (1, 2, 25):
+        assert [sched(k * 4 + i) for i in range(4)] == period
+
+
+def test_make_scheduler_closed_ready_boundaries():
+    """closed=0/ready=0 boundaries: record=1 makes EVERY cycle step a
+    RECORD_AND_RETURN; skip_first shifts the whole cycle, not just the
+    first period."""
+    sched = make_scheduler(closed=0, ready=0, record=1, repeat=0)
+    assert [sched(i) for i in range(3)] == [ProfilerState.RECORD_AND_RETURN] * 3
+
+    sched = make_scheduler(closed=2, ready=0, record=1, repeat=0, skip_first=3)
+    assert [sched(i) for i in range(9)] == [
+        ProfilerState.CLOSED, ProfilerState.CLOSED, ProfilerState.CLOSED,  # skip
+        ProfilerState.CLOSED, ProfilerState.CLOSED,                        # closed
+        ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED, ProfilerState.CLOSED,
+        ProfilerState.RECORD_AND_RETURN,
+    ]
+
+    # record is the only mandatory phase
+    import pytest
+
+    with pytest.raises(AssertionError):
+        make_scheduler(closed=1, ready=1, record=0)
+
+
+def test_export_chrome_tracing_contains_observability_spans(tmp_path):
+    """Spans from the observability layer ride the same record window and
+    land in the exported chrome trace with their own category."""
+    from paddle_tpu.observability import span
+
+    d = os.path.join(tmp_path, "log")
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with Profiler(scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                           repeat=1),
+                  on_trace_ready=export_chrome_tracing(d)) as p:
+        for _ in range(2):
+            with span("bench_step"):
+                with span("matmul_block"):
+                    _ = paddle.matmul(x, x)
+            p.step()
+    traces = [f for f in os.listdir(d) if f.endswith(".paddle_trace.json")]
+    assert traces
+    doc = json.load(open(os.path.join(d, traces[0])))
+    by_cat = {}
+    for e in doc["traceEvents"]:
+        by_cat.setdefault(e["cat"], set()).add(e["name"])
+    assert "bench_step" in by_cat["observability"]
+    assert "bench_step/matmul_block" in by_cat["observability"]
+    assert "matmul" in by_cat["operator"]

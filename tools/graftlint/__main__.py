@@ -30,7 +30,7 @@ EXIT_INTERNAL = 2
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="TPU/JAX-aware static analysis (rules GL001-GL005; "
+        description="TPU/JAX-aware static analysis (rules GL001-GL006; "
                     "see docs/LINTING.md)")
     p.add_argument("paths", nargs="*", help="files or directories to lint")
     p.add_argument("--baseline", metavar="FILE",

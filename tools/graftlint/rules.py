@@ -1,4 +1,4 @@
-"""Rule registry and the five shipped rules (GL001-GL005).
+"""Rule registry and the shipped rules (GL001-GL006).
 
 Each rule is a singleton with an id, a one-line title, a rationale (shown by
 `--list-rules` and docs/LINTING.md), and `check(project) -> Iterable[Finding]`.
@@ -160,6 +160,60 @@ def _file_collectors(project: LintProject) -> list["_GL001Collector"]:
     return cache
 
 
+def _traced_records(project: LintProject):
+    """Trace-reachability fixpoint shared by GL001 and GL006 (memoized on
+    the project): returns (collectors, traced id set, traced _FnRecords).
+    A record is traced when its function is decorated jit/to_static, passed
+    to a jax transform, transitively called from either (same-file name
+    matching — see HostSyncInTrace.rationale), or called from a
+    `with tracing_guard(True):` body."""
+    cache = getattr(project, "_graftlint_traced_records", None)
+    if cache is not None:
+        return cache
+    collectors = _file_collectors(project)
+    traced: set[int] = set()  # id(_FnRecord)
+    traced_recs: list[_FnRecord] = []
+
+    for col in collectors:
+        by_name: dict[str, list[_FnRecord]] = {}
+        for rec in col.fns:
+            by_name.setdefault(rec.name, []).append(rec)
+        worklist: list[str] = list(col.root_names)
+
+        def guard_callees(rec: _FnRecord, _wl=worklist):
+            for body in rec.guard_bodies:
+                for node in _walk_skipping_defs(body):
+                    if isinstance(node, ast.Call):
+                        n = _call_name(node.func)
+                        if n:
+                            _wl.append(n)
+
+        def mark(rec: _FnRecord, _wl=worklist):
+            if id(rec) in traced:
+                return
+            traced.add(id(rec))
+            traced_recs.append(rec)
+            _wl.extend(rec.calls)
+            guard_callees(rec)
+
+        for rec in col.fns:
+            if rec.is_root:
+                mark(rec)
+            else:
+                # a tracing_guard body is traced even when its enclosing
+                # function is not — seed its callees
+                guard_callees(rec)
+
+        while worklist:
+            name = worklist.pop()
+            for rec in by_name.get(name, []):
+                mark(rec)
+
+    cache = (collectors, traced, traced_recs)
+    project._graftlint_traced_records = cache
+    return cache
+
+
 class _GL001Collector(ast.NodeVisitor):
     """Per-file pass: function records, call edges, trace roots."""
 
@@ -231,44 +285,7 @@ class HostSyncInTrace(Rule):
     )
 
     def check(self, project: LintProject) -> Iterable[Finding]:
-        collectors = _file_collectors(project)
-        traced: set[int] = set()  # id(_FnRecord)
-        traced_recs: list[_FnRecord] = []
-
-        for col in collectors:
-            by_name: dict[str, list[_FnRecord]] = {}
-            for rec in col.fns:
-                by_name.setdefault(rec.name, []).append(rec)
-            worklist: list[str] = list(col.root_names)
-
-            def guard_callees(rec: _FnRecord, _wl=worklist):
-                for body in rec.guard_bodies:
-                    for node in _walk_skipping_defs(body):
-                        if isinstance(node, ast.Call):
-                            n = _call_name(node.func)
-                            if n:
-                                _wl.append(n)
-
-            def mark(rec: _FnRecord, _wl=worklist):
-                if id(rec) in traced:
-                    return
-                traced.add(id(rec))
-                traced_recs.append(rec)
-                _wl.extend(rec.calls)
-                guard_callees(rec)
-
-            for rec in col.fns:
-                if rec.is_root:
-                    mark(rec)
-                else:
-                    # a tracing_guard body is traced even when its enclosing
-                    # function is not — seed its callees
-                    guard_callees(rec)
-
-            while worklist:
-                name = worklist.pop()
-                for rec in by_name.get(name, []):
-                    mark(rec)
+        collectors, traced, traced_recs = _traced_records(project)
 
         seen: set[tuple[str, int, str]] = set()
         findings: list[Finding] = []
@@ -708,3 +725,68 @@ class RngKeyReuse(Rule):
                     "before sampling again", ctx.snippet_at(key_arg.lineno)))
             else:
                 used[name] = key_arg.lineno
+
+
+# --------------------------------------------------------------------------- #
+# GL006 unlabeled hot-path metric
+# --------------------------------------------------------------------------- #
+
+# Unambiguous emission verbs of the observability metrics API
+# (paddle_tpu/observability/metrics.py Counter.inc / Histogram.observe).
+_METRIC_EMIT_ALWAYS = {"inc", "observe"}
+# Verbs that collide with stdlib names (set()/dict.add): flagged only when
+# the receiver chain reads metric-ish.
+_METRIC_EMIT_GUARDED = {"set", "add", "dec"}
+_METRICISH_HINTS = ("metric", "counter", "gauge", "hist")
+
+
+def _metricish_receiver(func: ast.Attribute) -> bool:
+    chain = _dotted_chain(func)
+    return any(h in part.lower() for part in chain[:-1] for h in _METRICISH_HINTS)
+
+
+@register
+class HotPathMetric(Rule):
+    id = "GL006"
+    title = "unlabeled hot-path metric: emission inside a traced region"
+    rationale = (
+        "A metric emitted from inside a jit/to_static trace only executes "
+        "via a host callback — XLA must round-trip to Python every step, "
+        "serializing the TPU pipeline exactly like a host sync (and under "
+        "plain tracing it silently runs once at trace time, recording "
+        "nothing). Accumulate on-device and emit at the step boundary "
+        "(`StepTimeline.step_end` / the fit loop), or pre-bind the labeled "
+        "cell outside the trace. Reachability matches GL001: jit/to_static "
+        "decorators, jax-transform arguments, tracing_guard bodies, and "
+        "their same-file transitive callees."
+    )
+
+    def check(self, project: LintProject) -> Iterable[Finding]:
+        collectors, traced, traced_recs = _traced_records(project)
+
+        def scan_region(ctx: FileContext, body, where: str):
+            for node in _walk_skipping_defs(body):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                if attr in _METRIC_EMIT_ALWAYS or (
+                        attr in _METRIC_EMIT_GUARDED
+                        and _metricish_receiver(node.func)):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"metric emission `.{attr}()` is reachable under "
+                        f"tracing via {where} — a per-step host callback; "
+                        "accumulate on-device and emit at the step boundary")
+
+        for rec in traced_recs:
+            yield from scan_region(rec.ctx, rec.node.body,
+                                   f"traced function `{rec.qualname}`")
+        for col in collectors:
+            for rec in col.fns:
+                if id(rec) in traced:
+                    continue
+                for body in rec.guard_bodies:
+                    yield from scan_region(
+                        rec.ctx, body,
+                        f"`with tracing_guard(...)` in `{rec.qualname}`")

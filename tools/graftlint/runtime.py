@@ -4,12 +4,16 @@ GL001 is a static reachability over-approximation; this module is its dynamic
 ground truth. It plugs into the two hook points framework/core.py already
 exposes:
 
-- `set_sync_observer` — fired by Tensor.__bool__/__int__/__float__/.numpy()/
-  .item()/.tolist(), i.e. exactly the host-sync surface GL001 models. While
-  `in_tracing()` is true the observer raises `HostSyncInTraceError` (mode
-  "raise", the default) or emits a `GraftlintRuntimeWarning` (mode "warn").
-- `set_op_input_interceptor` — used to census op names dispatched under
-  tracing, so the report shows *what ran traced* next to what synced.
+- the sync-observer chain (`add_sync_observer`) — fired by
+  Tensor.__bool__/__int__/__float__/.numpy()/.item()/.tolist(), i.e. exactly
+  the host-sync surface GL001 models. While `in_tracing()` is true the
+  observer raises `HostSyncInTraceError` (mode "raise", the default) or emits
+  a `GraftlintRuntimeWarning` (mode "warn"). Every sync (traced or not) also
+  bumps `host_syncs_total`, which the observability StepTimeline must agree
+  with on the same run (tests/test_observability.py).
+- the op-input-interceptor chain (`add_op_input_interceptor`) — used to
+  census op names dispatched under tracing, so the report shows *what ran
+  traced* next to what synced.
 
 The report also folds in `dispatch_cache_stats()` so a jit-blacklisted hot op
 (`uncacheable_ops` — every call retraces eagerly) surfaces in the same output
@@ -19,11 +23,11 @@ Activation: `GRAFTLINT_RUNTIME=1` (raise) or `GRAFTLINT_RUNTIME=warn` in the
 environment — paddle_tpu/__init__.py installs the checks at import time when
 the variable is set — or call `install_runtime_checks()` directly (tests).
 
-Caveat: both hooks are single-slot. The installer chains whatever observer /
-interceptor was present, and sot.py's capture path save/restores around
-itself, but amp's autocast *replaces* the interceptor — install runtime
-checks first and the op census simply pauses while autocast is active; sync
-enforcement (the part that matters) is unaffected.
+Both hooks register through the chained add_*/remove_* API, so these checks
+compose with amp autocast (which owns the base interceptor slot), the SOT
+capture (which owns the base observer slot), and the observability
+StepTimeline (a fellow chain entry) — enabling telemetry and
+GRAFTLINT_RUNTIME=1 together drops nothing.
 """
 
 from __future__ import annotations
@@ -53,10 +57,9 @@ class GraftlintRuntimeWarning(RuntimeWarning):
 _state = {
     "installed": False,
     "mode": "raise",
-    "prev_observer": None,
-    "prev_interceptor": None,
     "events": [],        # host syncs observed under tracing
     "op_census": {},     # op name -> calls dispatched while tracing
+    "syncs_total": 0,    # every observed sync, traced or not
 }
 
 
@@ -71,6 +74,32 @@ def _mode_from_env() -> str:
     return "warn" if raw == "warn" else "raise"
 
 
+def _observer(kind, tensor):
+    _state["syncs_total"] += 1
+    if _core().in_tracing():
+        shape = tuple(getattr(tensor, "shape", ()) or ())
+        _state["events"].append({"kind": kind, "shape": shape})
+        msg = (
+            f"graftlint GL001 (runtime): host sync `{kind}` on a "
+            f"tensor of shape {shape} while a jax trace is active — "
+            "this concretizes the tracer (trace failure, or a silent "
+            "per-step device round trip on fallback paths). Move the "
+            "sync out of the traced region, or set GRAFTLINT_RUNTIME="
+            "warn to only report."
+        )
+        if _state["mode"] == "raise":
+            raise HostSyncInTraceError(msg)
+        warnings.warn(msg, GraftlintRuntimeWarning, stacklevel=3)
+    return None
+
+
+def _interceptor(name, values):
+    if _core().in_tracing():
+        census = _state["op_census"]
+        census[name] = census.get(name, 0) + 1
+    return values
+
+
 def install_runtime_checks(mode: str | None = None) -> None:
     """Idempotent; `mode` is "raise" (default) or "warn"."""
     core = _core()
@@ -80,41 +109,9 @@ def install_runtime_checks(mode: str | None = None) -> None:
     mode = mode or _mode_from_env()
     if mode not in ("raise", "warn"):
         raise ValueError(f"graftlint runtime mode must be 'raise'/'warn', got {mode!r}")
-    _state.update(mode=mode,
-                  prev_observer=core._sync_observer,
-                  prev_interceptor=core._op_input_interceptor)
-
-    prev_obs = _state["prev_observer"]
-    prev_icp = _state["prev_interceptor"]
-
-    def _observer(kind, tensor):
-        rep = prev_obs(kind, tensor) if prev_obs is not None else None
-        if core.in_tracing():
-            shape = tuple(getattr(tensor, "shape", ()) or ())
-            _state["events"].append({"kind": kind, "shape": shape})
-            msg = (
-                f"graftlint GL001 (runtime): host sync `{kind}` on a "
-                f"tensor of shape {shape} while a jax trace is active — "
-                "this concretizes the tracer (trace failure, or a silent "
-                "per-step device round trip on fallback paths). Move the "
-                "sync out of the traced region, or set GRAFTLINT_RUNTIME="
-                "warn to only report."
-            )
-            if _state["mode"] == "raise":
-                raise HostSyncInTraceError(msg)
-            warnings.warn(msg, GraftlintRuntimeWarning, stacklevel=3)
-        return rep
-
-    def _interceptor(name, values):
-        if prev_icp is not None:
-            values = prev_icp(name, values)
-        if core.in_tracing():
-            census = _state["op_census"]
-            census[name] = census.get(name, 0) + 1
-        return values
-
-    core.set_sync_observer(_observer)
-    core.set_op_input_interceptor(_interceptor)
+    _state["mode"] = mode
+    core.add_sync_observer(_observer)
+    core.add_op_input_interceptor(_interceptor)
     _state["installed"] = True
 
 
@@ -122,14 +119,15 @@ def uninstall_runtime_checks() -> None:
     if not _state["installed"]:
         return
     core = _core()
-    core.set_sync_observer(_state["prev_observer"])
-    core.set_op_input_interceptor(_state["prev_interceptor"])
-    _state.update(installed=False, prev_observer=None, prev_interceptor=None)
+    core.remove_sync_observer(_observer)
+    core.remove_op_input_interceptor(_interceptor)
+    _state["installed"] = False
 
 
 def reset_runtime_events() -> None:
     _state["events"].clear()
     _state["op_census"].clear()
+    _state["syncs_total"] = 0
 
 
 def runtime_report() -> dict:
@@ -140,6 +138,7 @@ def runtime_report() -> dict:
     stats = core.dispatch_cache_stats()
     return {
         "mode": _state["mode"] if _state["installed"] else None,
+        "host_syncs_total": _state["syncs_total"],
         "host_syncs_in_trace": list(_state["events"]),
         "traced_op_census": dict(_state["op_census"]),
         "dispatch_cache": {k: stats[k] for k in ("hits", "misses", "bypass")},
@@ -151,7 +150,8 @@ def runtime_report() -> dict:
 def format_report() -> str:
     rep = runtime_report()
     lines = ["graftlint runtime report",
-             f"  host syncs under tracing: {len(rep['host_syncs_in_trace'])}"]
+             f"  host syncs observed: {rep['host_syncs_total']} "
+             f"({len(rep['host_syncs_in_trace'])} under tracing)"]
     for e in rep["host_syncs_in_trace"][:20]:
         lines.append(f"    - {e['kind']} shape={e['shape']}")
     dc = rep["dispatch_cache"]
