@@ -85,8 +85,10 @@ def _probe_backend(max_tries=2, timeout_s=180.0):
 
 
 def _timed_steps(step_fn, steps, trace_dir=None, warmup=3, rung=None):
-    """Warmed-up timed loop; returns seconds/step. step_fn() must return a
-    device value whose float() forces completion.
+    """Warmed-up timed loop; returns (seconds/step, timeline_info).
+    step_fn() must return a device value whose float() forces completion.
+    timeline_info carries the overlap aggregate over the timed steps when
+    --emit-metrics installed a StepTimeline ({} otherwise).
 
     warmup: executions AFTER compile before the clock starts — the first few
     runs of a fresh executable through the axon tunnel pay settling costs
@@ -109,6 +111,7 @@ def _timed_steps(step_fn, steps, trace_dir=None, warmup=3, rung=None):
     from paddle_tpu.observability import spans as _obs_spans
 
     tl = _obs_spans.active_timeline()  # installed by --emit-metrics
+    timed_records = []
     t0 = time.perf_counter()
     last = None
     for i in range(steps):
@@ -119,14 +122,24 @@ def _timed_steps(step_fn, steps, trace_dir=None, warmup=3, rung=None):
             # rung tag: a BENCH_MATRIX run interleaves several rungs'
             # step sequences in one JSONL — untagged records with repeating
             # step indices would be unattributable
-            tl.step_end(extra={"rung": rung} if rung else None)
+            timed_records.append(
+                tl.step_end(extra={"rung": rung} if rung else None))
         if prof is not None:
             prof.step()
     _ = float(last)
     dt = (time.perf_counter() - t0) / steps
     if prof is not None:
         prof.stop()
-    return dt
+    info = {}
+    if timed_records:
+        agg = _obs_spans.aggregate_overlap(
+            r.get("overlap") or {} for r in timed_records if r)
+        n = max(len(timed_records), 1)
+        info = {
+            "overlap_fraction": round(agg["fraction"], 4),
+            "comm_exposed_s_per_step": round(agg["exposed_s"] / n, 6),
+        }
+    return dt, info
 
 
 def _emit(name, dt, flops, tokens=None, extra=None):
@@ -270,10 +283,10 @@ def run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir=None):
             dist.env.set_global_mesh(None)
             continue
 
-    dt = _timed_steps(lambda: step(ids, labels), steps, trace_dir,
-                      rung=name)
+    dt, tl_info = _timed_steps(lambda: step(ids, labels), steps, trace_dir,
+                               rung=name)
     flops = _decoder_flops(cfg, batch, seq)
-    extra = {}
+    extra = dict(tl_info)
     if name == "gpt3_1p3b":
         extra["recipe"] = "bf16_params+bf16_moments+recompute"
     if init_error:
@@ -304,10 +317,11 @@ def run_llama_rung(on_tpu):
     step, ids, labels = _decoder_step(cfg, batch, seq, on_tpu,
                                       sharding_stage=2)
     _ = float(step(ids, labels))
-    dt = _timed_steps(lambda: step(ids, labels), steps,
-                      rung="llama_7bshape")
+    dt, tl_info = _timed_steps(lambda: step(ids, labels), steps,
+                               rung="llama_7bshape")
     return _emit(f"llama_7bshape_flashmask_bs{batch}x{seq}", dt,
-                 _decoder_flops(cfg, batch, seq), batch * seq)
+                 _decoder_flops(cfg, batch, seq), batch * seq,
+                 extra=tl_info or None)
 
 
 def run_bert_rung(on_tpu):
@@ -345,15 +359,16 @@ def run_bert_rung(on_tpu):
     mlab = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, n_mask)))
     nlab = paddle.to_tensor(rng.integers(0, 2, (batch,)))
     _ = float(step([ids, tt, am, mpos], [mlab, nlab]))
-    dt = _timed_steps(lambda: step([ids, tt, am, mpos], [mlab, nlab]),
-                      steps, rung="bert_base")
+    dt, tl_info = _timed_steps(lambda: step([ids, tt, am, mpos], [mlab, nlab]),
+                               steps, rung="bert_base")
     h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
     # encoder 12h^2/layer params, attention quadratic, + MLM head on n_mask
     n_enc = 12 * L * h * h
     flops = (6.0 * n_enc * batch * seq
              + 12.0 * L * h * seq * batch * seq
              + 6.0 * batch * n_mask * h * V)
-    return _emit(f"bert_base_bs{batch}x{seq}", dt, flops, batch * seq)
+    return _emit(f"bert_base_bs{batch}x{seq}", dt, flops, batch * seq,
+                 extra=tl_info or None)
 
 
 def run_unet_rung(on_tpu):
@@ -395,8 +410,8 @@ def run_unet_rung(on_tpu):
     noise = paddle.to_tensor(
         rng.normal(size=(batch, cfg.out_channels, hw, hw)).astype(np.float32))
     _ = float(step([noisy, t, ctx], noise))
-    dt = _timed_steps(lambda: step([noisy, t, ctx], noise), steps,
-                      rung="unet_sd")
+    dt, tl_info = _timed_steps(lambda: step([noisy, t, ctx], noise), steps,
+                               rung="unet_sd")
     peak, kind = _peak_flops(jax.devices()[0])
     line = {
         "metric": f"unet_sd_bs{batch}x{hw}_{kind.replace(' ', '_')}",
@@ -404,6 +419,7 @@ def run_unet_rung(on_tpu):
         "unit": "latents_per_sec",
         "vs_baseline": 0.0,  # reference publishes no UNet number
         "step_time_s": round(dt, 4),
+        **tl_info,
     }
     print(json.dumps(line), flush=True)
     return line
@@ -432,10 +448,11 @@ def run_resnet_rung(on_tpu):
     img = paddle.to_tensor(rng.normal(size=(batch, 3, hw, hw)).astype(np.float32))
     lab = paddle.to_tensor(rng.integers(0, 1000, (batch, 1)))
     _ = float(step(img, lab))
-    dt = _timed_steps(lambda: step(img, lab), steps, rung="resnet50")
+    dt, tl_info = _timed_steps(lambda: step(img, lab), steps, rung="resnet50")
     flops = 3.0 * fwd_flops * batch  # fwd + ~2x bwd
     return _emit(f"resnet50_bs{batch}" if on_tpu else f"resnet18_bs{batch}",
-                 dt, flops, extra={"images_per_sec": round(batch / dt, 1)})
+                 dt, flops,
+                 extra={"images_per_sec": round(batch / dt, 1), **tl_info})
 
 
 def main():

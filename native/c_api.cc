@@ -34,6 +34,11 @@ struct PD_Predictor {
 struct PD_Tensor {
   PyObject* handle;  // paddle_tpu.inference._Handle
   std::vector<int32_t> shape;
+  // output handles refresh their cached shape from the live array on every
+  // shape query: a handle fetched BEFORE PD_PredictorRun would otherwise
+  // keep a stale/empty shape, and a caller sizing its buffer from it
+  // overflows when the post-run copy delivers more bytes
+  bool from_output = false;
 };
 
 namespace {
@@ -111,7 +116,13 @@ void copy_to_cpu(PD_Tensor* t, void* data, size_t elem) {
   if (!c) { PyErr_Print(); return; }
   Py_buffer view;
   if (PyObject_GetBuffer(c, &view, PyBUF_CONTIG_RO) == 0) {
-    std::memcpy(data, view.buf, (size_t)view.len);
+    // clamp to the CALLER-VISIBLE size: the caller sized `data` from the
+    // cached shape (PD_TensorGetShape), so if the live array grew since —
+    // output handle fetched before PD_PredictorRun, shapes refreshed by a
+    // later run — copying view.len would overflow the caller's buffer
+    size_t cap = numel(t->shape) * elem;
+    std::memcpy(data, view.buf,
+                (size_t)view.len < cap ? (size_t)view.len : cap);
     PyBuffer_Release(&view);
   }
   Py_DECREF(c);
@@ -246,6 +257,7 @@ PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
   PD_Tensor* t = get_handle(p, "get_output_handle", name);
   if (t) {
     Gil g;
+    t->from_output = true;
     refresh_shape(t);
   }
   return t;
@@ -274,9 +286,25 @@ void PD_TensorReshape(PD_Tensor* t, size_t ndim, const int32_t* shape) {
   t->shape.assign(shape, shape + ndim);
 }
 
-size_t PD_TensorGetNumDims(PD_Tensor* t) { return t->shape.size(); }
+// Output handles created before the predictor ran have no shape yet; the
+// lazy refresh below fills it on the first query after a run instead of
+// leaving the caller to size its buffer from an empty shape. Handles with
+// a known shape are immutable in this runtime (Predictor.run builds fresh
+// handles), so non-empty shapes are never re-queried — and the memcpy
+// clamp in copy_to_cpu stays the hard overflow guarantee either way.
+size_t PD_TensorGetNumDims(PD_Tensor* t) {
+  if (t->from_output && t->shape.empty()) {
+    Gil g;
+    refresh_shape(t);
+  }
+  return t->shape.size();
+}
 
 void PD_TensorGetShape(PD_Tensor* t, int32_t* out) {
+  if (t->from_output && t->shape.empty()) {
+    Gil g;
+    refresh_shape(t);
+  }
   std::memcpy(out, t->shape.data(), t->shape.size() * sizeof(int32_t));
 }
 

@@ -41,9 +41,13 @@ _report_history: list[str] = []
 _report_cursor = 0  # history entries already handed out by drain_report
 _REPORT_HISTORY_CAP = 1 << 20  # bytes retained for peek
 
-# comm_task interval observers: fn(desc, start_ns, end_ns), fired on region
-# exit whether or not the native watchdog is enabled — the StepTimeline's
-# source for per-step collective/blocking intervals.
+# comm_task interval observers: fn(desc, start_ns, end_ns, kind), fired on
+# region exit whether or not the native watchdog is enabled — the
+# StepTimeline's source for per-step collective/blocking intervals. `kind`
+# classifies the region for the overlap accounting (spans.overlap_stats):
+# "comm" regions are communication whose exposure matters; other kinds
+# ("step" for the trainer's whole-step watchdog region) are deadline
+# tracking only and stay out of the comm interval union.
 _task_observers: list = []
 
 
@@ -170,12 +174,14 @@ def disable():
 
 
 @contextlib.contextmanager
-def comm_task(desc: str, timeout_seconds=None):
+def comm_task(desc: str, timeout_seconds=None, kind: str = "comm"):
     """Track a blocking region; near-free when the watchdog is off and no
     task observer is registered. Observers see every region's (desc, start,
-    end) interval regardless of whether the native watchdog is enabled —
-    deadline enforcement needs the native thread, timeline stitching does
-    not."""
+    end, kind) interval regardless of whether the native watchdog is
+    enabled — deadline enforcement needs the native thread, timeline
+    stitching does not. `kind="comm"` (default) marks communication whose
+    exposed time the overlap accounting charges; pass `kind="step"` (or any
+    other tag) for deadline-only regions like a whole train step."""
     with _lock:
         wd = _wd
         if wd is None:
@@ -200,7 +206,7 @@ def comm_task(desc: str, timeout_seconds=None):
             t1 = time.perf_counter_ns()
             for fn in list(_task_observers):
                 try:
-                    fn(desc, t0, t1)
+                    fn(desc, t0, t1, kind)
                 except Exception as e:  # noqa: BLE001
                     # an observer failure must not mask the region's own
                     # exception (we are in a finally block)

@@ -273,8 +273,11 @@ class ResilientTrainer:
                 if tl is not None:
                     tl.step_begin(step)
                 t0 = time.perf_counter()
+                # kind="step": a deadline-only region — the whole step is
+                # not "communication", and counting it in the overlap
+                # accounting would swamp the real comm intervals inside it
                 with comm_watchdog.comm_task(f"train_step/{step}",
-                                             self.step_timeout):
+                                             self.step_timeout, kind="step"):
                     # inside the watchdog region: an injected stall here is
                     # exactly a step wedged in a collective
                     faults.fault_point("trainer.before_step")

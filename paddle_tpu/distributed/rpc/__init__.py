@@ -72,12 +72,25 @@ def _recv_msg(conn) -> bytes:
 
 class _RpcServer:
     """Thread-pooled request/response server: one pickled
-    (fn, args, kwargs) in, one pickled ("ok"|"err", payload) out."""
+    (fn, args, kwargs) in, one pickled ("ok"|"err", payload) out.
 
-    def __init__(self, host="0.0.0.0", n_threads=8):
+    `host` should be the rendezvous-routed interface (init_rpc passes it);
+    a wildcard bind would expose the unauthenticated pickle endpoint on
+    every interface of the host."""
+
+    def __init__(self, host="127.0.0.1", n_threads=8):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, 0))
+        try:
+            self._sock.bind((host, 0))
+        except OSError:
+            # interface detection can misfire (containers with asymmetric
+            # routing): fall back to loopback rather than 0.0.0.0 — a
+            # reachable-but-narrow bind beats an open one; cross-host
+            # setups pin PADDLE_WORKER_IP explicitly
+            self._sock.bind(("127.0.0.1", 0))
+            host = "127.0.0.1"
+        self.host = host
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._pool = ThreadPoolExecutor(max_workers=n_threads,
@@ -161,13 +174,19 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
                        else os.environ["PADDLE_MASTER_ENDPOINT"])
     master_addr, master_port = master_endpoint.rsplit(":", 1)
 
-    server = _RpcServer()
+    # bind the request server to the interface peers will actually dial
+    # (the one that routes to the master) instead of 0.0.0.0 — the
+    # unauthenticated-pickle endpoint must not listen on every interface
+    # of a multi-homed host (same address that gets registered below)
+    ip = os.environ.get("PADDLE_WORKER_IP") or _self_ip(master_addr)
+    server = _RpcServer(host=ip)
     store = None
     try:
         store = TCPStore(master_addr, int(master_port),
                          is_master=(rank == 0), world_size=world_size)
-        ip = os.environ.get("PADDLE_WORKER_IP") or _self_ip(master_addr)
-        me = WorkerInfo(name, rank, ip, server.port)
+        # register the address the server actually BOUND (the loopback
+        # fallback may have overridden `ip`) — peers dial what we advertise
+        me = WorkerInfo(name, rank, server.host, server.port)
         store.set(f"rpc/worker/{rank}", pickle.dumps(me))
 
         workers = {}

@@ -17,6 +17,9 @@ jax.jit over the hybrid mesh with:
 
 from __future__ import annotations
 
+import os
+import time
+
 import numpy as np
 
 import jax
@@ -27,7 +30,25 @@ from ..framework.core import Tensor
 from ..jit import TrainStep, _unwrap_pytree
 from . import env as _env
 
-__all__ = ["DistributedTrainStep", "fsdp_spec", "shard_params_for_stage3"]
+__all__ = ["DistributedTrainStep", "fsdp_spec", "shard_params_for_stage3",
+           "host_memory_kind"]
+
+
+def host_memory_kind(mesh):
+    """The host-side memory kind this mesh's devices can address —
+    "pinned_host" on TPU, "unpinned_host" on the CPU backend (where host
+    and device memory coincide, so offload degenerates to a no-op
+    placement but exercises the same code path), None when the runtime
+    has no memories API at all."""
+    try:
+        dev = next(iter(mesh.devices.flat))
+        kinds = {m.kind for m in dev.addressable_memories()}
+    except Exception:  # graftlint: disable=GL003 probing an optional runtime API (pre-memories jaxlibs raise various types); the fallback IS the handling
+        return "pinned_host"  # pre-memories probing: keep the TPU default
+    for k in ("pinned_host", "unpinned_host"):
+        if k in kinds:
+            return k
+    return None
 
 
 def fsdp_spec(shape, axis="sharding", mesh=None, existing=None):
@@ -67,10 +88,36 @@ def shard_params_for_stage3(model, axis="sharding", mesh=None):
         p.dist_attr = fsdp_spec(tuple(p.shape), axis, mesh, existing)
 
 
+def _bucket_tag(shardings):
+    """Identity on a tuple of param values whose VJP applies the grad's
+    reduce-scatter sharding constraint AT THE POINT the backward produces
+    the bucket's cotangents — i.e. per-layer inside the backward, where XLA
+    can overlap the collective with the remaining backward compute — rather
+    than at the step-end consumption site. The optimization_barrier ties the
+    bucket's grads together so their reduce-scatters issue as one group
+    (EagerReducer bucket semantics, reference reducer.cc)."""
+
+    @jax.custom_vjp
+    def tag(*xs):
+        return xs
+
+    def tag_fwd(*xs):
+        return xs, None
+
+    def tag_bwd(_, gs):
+        gs = jax.lax.optimization_barrier(tuple(gs))
+        return tuple(jax.lax.with_sharding_constraint(g, s)
+                     for g, s in zip(gs, shardings))
+
+    tag.defvjp(tag_fwd, tag_bwd)
+    return tag
+
+
 class DistributedTrainStep(TrainStep):
     def __init__(self, model, loss_fn, optimizer, mesh=None,
                  input_specs=None, label_specs=None, sharding_stage=None,
-                 offload=False, batch_axes=("dp", "sharding"), **kw):
+                 offload=False, batch_axes=("dp", "sharding"),
+                 comm_overlap=None, **kw):
         self.mesh = mesh or _env.default_mesh()
         _env.set_global_mesh(self.mesh)
         if sharding_stage is None:
@@ -81,6 +128,16 @@ class DistributedTrainStep(TrainStep):
         self.batch_axes = tuple(a for a in batch_axes if self.mesh.shape.get(a, 1) >= 1)
         self.input_specs = input_specs
         self.label_specs = label_specs
+        # comm_overlap (default on; PADDLE_TPU_COMM_OVERLAP=0 restores the
+        # exposed-collective step for A/B runs): in-backward reduce-scatter
+        # bucket tags + in-program offload streaming + overlap-attributed
+        # host transfers. Fixed at construction — it shapes the compiled
+        # program, so an A/B needs two instances, not a flag flip.
+        if comm_overlap is None:
+            comm_overlap = os.environ.get("PADDLE_TPU_COMM_OVERLAP", "1") != "0"
+        self.comm_overlap = bool(comm_overlap)
+        self._host_kind = host_memory_kind(self.mesh)
+        self._bucket_plan = None
         if sharding_stage == 3:
             shard_params_for_stage3(model, mesh=self.mesh)
         super().__init__(model, loss_fn, optimizer, **kw)
@@ -138,8 +195,92 @@ class DistributedTrainStep(TrainStep):
         return jax.lax.with_sharding_constraint(
             np_, self._sharding(self._param_spec(name)))
 
+    # -- comm/compute overlap: in-backward grad reduce-scatter ----------- #
+
+    def _grad_bucket_plan(self):
+        """[(param names, bucket tag fn)] in REVERSE topological order (the
+        order the backward pass produces grads), bucketed by cumulative
+        bytes (PADDLE_TPU_RS_BUCKET_MB, default 25 — the EagerReducer
+        bucket size). Only params whose update layout differs from their
+        param layout are tagged; the rest have no reduce-scatter to place."""
+        if self._bucket_plan is not None:
+            return self._bucket_plan
+        plan = []
+        if (self.comm_overlap and self.sharding_stage in (2, 3)
+                and self.mesh.shape.get("sharding", 1) > 1):
+            cap = float(os.environ.get("PADDLE_TPU_RS_BUCKET_MB", "25")) * 1e6
+            names, shards, size = [], [], 0.0
+            for name in reversed(list(self._state.params)):
+                spec = self._update_spec(name)
+                if spec == self._param_spec(name):
+                    continue  # grad already produced in its update layout
+                p = self._state.params[name]
+                names.append(name)
+                shards.append(self._sharding(spec))
+                size += (int(np.prod(p.shape))
+                         * jnp.dtype(p.dtype).itemsize)
+                if size >= cap:
+                    plan.append((tuple(names), _bucket_tag(tuple(shards))))
+                    names, shards, size = [], [], 0.0
+            if names:
+                plan.append((tuple(names), _bucket_tag(tuple(shards))))
+        self._bucket_plan = plan
+        return plan
+
+    def _tag_grad_buckets(self, p):
+        plan = self._grad_bucket_plan()
+        if not plan:
+            return p
+        p = dict(p)
+        for names, tag in plan:
+            for name, v in zip(names, tag(*(p[n] for n in names))):
+                p[name] = v
+        return p
+
+    # -- comm/compute overlap: offload state streaming ------------------- #
+
+    def _offload_streaming(self):
+        """In-program host<->device streaming of the optimizer states: the
+        compiled program itself device_puts them in at the start and back to
+        host memory per-param after each update, so XLA overlaps the copies
+        with compute instead of the host serializing them around the step."""
+        return (self.offload and self.comm_overlap
+                and self._host_kind is not None)
+
+    def _fetch_opt_states(self, opt_states):
+        if not self._offload_streaming():
+            return opt_states
+        return {
+            k: {sk: jax.device_put(
+                    sv, self._sharding(self._opt_state_spec(k, sk, sv)))
+                if hasattr(sv, "shape") else sv
+                for sk, sv in st.items()}
+            for k, st in opt_states.items()
+        }
+
+    def _emit_opt_state(self, name, st):
+        if not self._offload_streaming():
+            return st
+        return {sk: jax.device_put(
+                    sv, self._sharding(self._opt_state_spec(name, sk, sv),
+                                       host=True))
+                if hasattr(sv, "shape") else sv
+                for sk, sv in st.items()}
+
+    def _post_dispatch(self):
+        # non-streaming offload with overlap on: issue the d2h restream
+        # INSIDE the compute span, while the dispatched program is still
+        # executing — the device_puts queue behind the step's outputs, so
+        # they pipeline against the tail of the computation instead of
+        # running as a post-step barrier
+        if self.offload and self.comm_overlap and not self._offload_streaming():
+            from . import comm_watchdog
+
+            with comm_watchdog.comm_task("offload/d2h", kind="comm"):
+                self._move_opt_states(host=True)
+
     def _sharding(self, spec, host=False):
-        kind = "pinned_host" if host else None
+        kind = self._host_kind if host else None
         return NamedSharding(self.mesh, spec if spec is not None else P(),
                              memory_kind=kind)
 
@@ -178,11 +319,17 @@ class DistributedTrainStep(TrainStep):
                                            host=host))
 
     def __call__(self, inputs, labels):
-        if self.offload:
-            # stream optimizer states host→device for the update and back
-            # afterwards (reference: GroupSharded offload=True keeping the
-            # moments on CPU between steps, group_sharded_stage3.py offload)
-            self._move_opt_states(host=False)
+        from . import comm_watchdog
+
+        streaming = self._offload_streaming()
+        if self.offload and not streaming:
+            # host-side move barrier (legacy / no-memories-API path): stream
+            # optimizer states host→device for the update (reference:
+            # GroupSharded offload=True keeping the moments on CPU between
+            # steps, group_sharded_stage3.py offload). With streaming the
+            # compiled program carries these transfers itself.
+            with comm_watchdog.comm_task("offload/h2d", kind="comm"):
+                self._move_opt_states(host=False)
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         if not isinstance(labels, (list, tuple)):
@@ -191,9 +338,31 @@ class DistributedTrainStep(TrainStep):
         raw_lb = [_unwrap_pytree(l if isinstance(l, Tensor) else Tensor(jnp.asarray(np.asarray(l)))) for l in labels]
         in_specs = self.input_specs or [self._batch_spec(a) for a in raw_in]
         lb_specs = self.label_specs or [self._batch_spec(a) for a in raw_lb]
-        placed_in = [jax.device_put(a, self._sharding(s)) for a, s in zip(raw_in, in_specs)]
-        placed_lb = [jax.device_put(a, self._sharding(s)) for a, s in zip(raw_lb, lb_specs)]
+        # the previous step's program still executing (async dispatch) means
+        # this step's input h2d is genuinely pipelined behind device compute.
+        # The credit is conservative: is_ready() (a non-blocking peek) must
+        # report busy BOTH before and after the placement window, or no
+        # compute span is recorded — a program finishing mid-window drops
+        # the whole credit rather than inflating overlap_fraction.
+        prev = getattr(self, "_inflight", None)
+        pipelined = (self.comm_overlap and prev is not None
+                     and hasattr(prev, "is_ready") and not prev.is_ready())
+        with comm_watchdog.comm_task("h2d/inputs", kind="comm"):
+            t0 = time.perf_counter_ns() if pipelined else 0
+            placed_in = [jax.device_put(a, self._sharding(s)) for a, s in zip(raw_in, in_specs)]
+            placed_lb = [jax.device_put(a, self._sharding(s)) for a, s in zip(raw_lb, lb_specs)]
+            if pipelined and not prev.is_ready():
+                from ..observability import spans as _obs_spans
+
+                _obs_spans.record_span("train_step/prev_step_inflight",
+                                       t0, time.perf_counter_ns(),
+                                       kind="compute")
         loss = super().__call__([Tensor(a) for a in placed_in], [Tensor(a) for a in placed_lb])
-        if self.offload:
-            self._move_opt_states(host=True)
+        self._inflight = loss._value
+        if self.offload and not streaming and not self.comm_overlap:
+            # pre-change semantics: the d2h restream runs as an exposed
+            # post-step barrier (comm_overlap=True issues it inside the
+            # compute span via _post_dispatch instead)
+            with comm_watchdog.comm_task("offload/d2h", kind="comm"):
+                self._move_opt_states(host=True)
         return loss

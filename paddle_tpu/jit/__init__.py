@@ -320,6 +320,12 @@ class TrainStep:
                       if getattr(model, "pp_schedule", None) == "1f1b" else None)
 
         def compute_loss(p, b, rng, batch):
+            # grad-overlap hook: DistributedTrainStep tags params with
+            # custom-VJP bucket identities whose backward applies the
+            # reduce-scatter sharding constraint where the grad is PRODUCED
+            # (per-layer, against remaining backward compute) instead of at
+            # the step-end consumption site
+            p = self._tag_grad_buckets(p)
             saved = state.swap_in(p, b)
             saved_rng = rnd.get_rng_state()
             rnd.set_rng_state((rng,))
@@ -342,6 +348,10 @@ class TrainStep:
                 rnd.set_rng_state(saved_rng)
 
         def train_step(p, opt_states, b, rng, step_i, lr, batch):
+            # offload streaming: host-resident optimizer states enter the
+            # program through in-program device_puts (overlappable h2d
+            # copies scheduled by XLA) instead of a host-side move barrier
+            opt_states = self._fetch_opt_states(opt_states)
             (loss, new_b), grads = jax.value_and_grad(compute_loss, has_aux=True)(p, b, rng, batch)
             if reg_specs:
                 grads = dict(grads)
@@ -378,7 +388,10 @@ class TrainStep:
                     ns_["master"] = np_
                     np_ = np_.astype(p[k].dtype)
                 new_p[k] = self._restore_param(k, np_)
-                new_states[k] = ns_
+                # per-param d2h emission point: under offload streaming the
+                # fresh states head back to host memory HERE, pipelined
+                # against the remaining params' updates
+                new_states[k] = self._emit_opt_state(k, ns_)
             return loss, new_p, new_states, new_b
 
         donate = (0, 1, 2) if self._donate else ()
@@ -401,6 +414,21 @@ class TrainStep:
 
     def _restore_param(self, name, np_):
         return np_
+
+    # comm-overlap hooks; identity here, overridden by DistributedTrainStep
+    def _tag_grad_buckets(self, p):
+        return p
+
+    def _fetch_opt_states(self, opt_states):
+        return opt_states
+
+    def _emit_opt_state(self, name, st):
+        return st
+
+    def _post_dispatch(self):
+        """Runs inside the step's compute span, right after the compiled
+        call returns (the device is still executing asynchronously) — the
+        overlap point for host-issued follow-up transfers."""
 
     def _train_out_shardings(self):
         """Optional out_shardings for (loss, new_p, new_states, new_b) —
@@ -426,9 +454,17 @@ class TrainStep:
         }
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_i = jnp.asarray(self._step, jnp.int32)
-        loss, self.params, self.opt_states, self.buffers = self._compiled(
-            self.params, self.opt_states, self.buffers, rnd.next_key(), step_i, lr, batch
-        )
+        from ..observability import spans as _obs_spans
+
+        # kind="compute": the step's compute interval for the overlap
+        # accounting (overlap_stats). The span covers the async dispatch and
+        # _post_dispatch — transfers issued there run while the device is
+        # still executing this step's program.
+        with _obs_spans.span("train_step/compiled", kind="compute"):
+            loss, self.params, self.opt_states, self.buffers = self._compiled(
+                self.params, self.opt_states, self.buffers, rnd.next_key(), step_i, lr, batch
+            )
+            self._post_dispatch()
         return Tensor(loss)
 
     def evaluate(self, inputs, labels):

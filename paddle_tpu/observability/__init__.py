@@ -39,6 +39,7 @@ from .spans import (
     disable_step_timeline,
     enable_step_timeline,
     fleet_step_summary,
+    overlap_stats,
     publish_step_record,
     span,
 )
@@ -60,6 +61,7 @@ __all__ = [
     "disable_step_timeline",
     "publish_step_record",
     "fleet_step_summary",
+    "overlap_stats",
     "FlightRecorder",
     "get_recorder",
     "reset_recorder",

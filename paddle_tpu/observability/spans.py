@@ -33,6 +33,8 @@ __all__ = [
     "disable_step_timeline",
     "publish_step_record",
     "fleet_step_summary",
+    "overlap_stats",
+    "record_span",
 ]
 
 _tls = threading.local()
@@ -81,6 +83,15 @@ class span:
         return wrapped
 
 
+def record_span(name, t0_ns, t1_ns, **attrs):
+    """Report an externally measured interval to the span sinks (profiler +
+    StepTimeline) after the fact — for windows whose qualification is only
+    known at their END (e.g. the input-h2d-behind-inflight-step compute
+    credit, which must verify the device was STILL busy when the window
+    closed before claiming overlap)."""
+    _emit_span(name, t0_ns, t1_ns, len(_span_stack()), attrs)
+
+
 def _emit_span(path, t0_ns, t1_ns, depth, attrs):
     # profiler sink: only while a record window is open
     from ..profiler import profiler as _prof_mod
@@ -91,6 +102,123 @@ def _emit_span(path, t0_ns, t1_ns, depth, attrs):
     tl = _active_timeline
     if tl is not None:
         tl._on_span(path, t0_ns, t1_ns, depth, attrs)
+
+
+# --------------------------------------------------------------------------- #
+# comm/compute overlap (interval-union math)
+# --------------------------------------------------------------------------- #
+
+
+def _merge_intervals(intervals):
+    """[(start, end), ...] -> sorted disjoint union (zero/negative-length
+    input intervals are dropped)."""
+    ivs = sorted((s, e) for s, e in intervals if e > s)
+    merged = []
+    for s, e in ivs:
+        if merged and s <= merged[-1][1]:
+            if e > merged[-1][1]:
+                merged[-1] = (merged[-1][0], e)
+        else:
+            merged.append((s, e))
+    return merged
+
+
+def _union_len(merged):
+    return sum(e - s for s, e in merged)
+
+
+def _intersect_len(a, b):
+    """Total length of the intersection of two DISJOINT-SORTED interval
+    lists (two-pointer sweep — O(n+m), not pairwise)."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_stats(comm_tasks, spans) -> dict:
+    """Per-step comm/compute overlap from a step record's interval lists.
+
+    comm intervals: `comm_tasks` entries with kind "comm" (deadline-only
+    regions like the trainer's whole-step watchdog tag are excluded).
+    compute intervals: spans explicitly tagged `kind="compute"` — driver
+    wrappers (fit/train_batch and friends) span the whole step including
+    its comm, so compute attribution is opt-in, not inferred.
+
+    `fraction` is the share of the comm interval UNION covered by the
+    compute union (T3's tracked-overlap metric, host-observed); a zero-comm
+    step reports 1.0 — nothing was exposed. `exposed_s` is the remainder,
+    the direct target of the overlap scheduling work.
+    """
+    comm = _merge_intervals(
+        (t.get("start_ns", 0) / 1e9,
+         t.get("start_ns", 0) / 1e9 + t.get("dur_s", 0.0))
+        for t in comm_tasks if t.get("kind", "comm") == "comm")
+    compute = _merge_intervals(
+        (s.get("start_ns", 0) / 1e9,
+         s.get("start_ns", 0) / 1e9 + s.get("dur_s", 0.0))
+        for s in spans
+        if (s.get("attrs") or {}).get("kind") == "compute")
+    comm_s = _union_len(comm)
+    covered = _intersect_len(comm, compute) if comm_s else 0.0
+    fraction = covered / comm_s if comm_s > 0 else 1.0
+    return {
+        "fraction": round(min(fraction, 1.0), 6),
+        "comm_s": round(comm_s, 6),
+        "covered_s": round(covered, 6),
+        "exposed_s": round(max(comm_s - covered, 0.0), 6),
+    }
+
+
+def aggregate_overlap(overlaps) -> dict:
+    """Roll per-step `overlap` dicts into one: fraction = total covered /
+    total comm, 1.0 when there was no comm at all. The ONE definition of
+    the roll-up convention — bench.py, `fleet_step_summary`, and
+    tools/overlap_report.py all aggregate through here."""
+    overlaps = list(overlaps)
+    comm = sum(o.get("comm_s", 0.0) for o in overlaps)
+    covered = sum(o.get("covered_s", 0.0) for o in overlaps)
+    return {
+        "fraction": round(covered / comm, 6) if comm > 0 else 1.0,
+        "comm_s": round(comm, 6),
+        "covered_s": round(covered, 6),
+        "exposed_s": round(max(comm - covered, 0.0), 6),
+    }
+
+
+# registry handles for the per-step overlap emission (HandleCache: survives
+# reset_default_registry in tests)
+_overlap_metrics = None
+
+
+def _emit_overlap_metrics(ov):
+    global _overlap_metrics
+    if _overlap_metrics is None:
+        from .metrics import HandleCache
+
+        _overlap_metrics = HandleCache(lambda reg: (
+            reg.gauge("step_overlap_fraction",
+                      "comm interval time covered by concurrent compute "
+                      "spans, last step"),
+            reg.counter("comm_exposed_seconds_total",
+                        "comm interval time NOT covered by compute spans"),
+            reg.counter("comm_overlapped_seconds_total",
+                        "comm interval time covered by compute spans"),
+        ))
+    frac, exposed, covered = _overlap_metrics.get()
+    frac.set(ov["fraction"])
+    if ov["exposed_s"]:
+        exposed.inc(ov["exposed_s"])
+    if ov["covered_s"]:
+        covered.inc(ov["covered_s"])
 
 
 # --------------------------------------------------------------------------- #
@@ -177,11 +305,13 @@ class StepTimeline:
             kinds[kind] = kinds.get(kind, 0) + 1
         return None  # never replace the synced value
 
-    def _on_comm_task(self, desc, t0_ns, t1_ns):
+    def _on_comm_task(self, desc, t0_ns, t1_ns, kind="comm"):
         cur = self._cur
         if cur is not None:
             cur["comm_tasks"].append(
-                {"desc": desc, "dur_s": round((t1_ns - t0_ns) / 1e9, 6)})
+                {"desc": desc, "kind": kind,
+                 "start_ns": t0_ns - cur["_t0_ns"],
+                 "dur_s": round((t1_ns - t0_ns) / 1e9, 6)})
 
     def _on_span(self, path, t0_ns, t1_ns, depth, attrs):
         cur = self._cur
@@ -225,6 +355,7 @@ class StepTimeline:
         t1 = time.perf_counter_ns()
         d0 = cur.pop("_dispatch0")
         d1 = core.dispatch_cache_stats()
+        overlap = overlap_stats(cur["comm_tasks"], cur["spans"])
         record = {
             "step": cur["step"],
             "t_wall": round(cur["t_wall"], 6),
@@ -233,11 +364,14 @@ class StepTimeline:
             "sync_kinds": cur["sync_kinds"],
             "comm_tasks": cur["comm_tasks"],
             "spans": cur["spans"],
+            "overlap": overlap,
+            "overlap_fraction": overlap["fraction"],
             "dispatch": {k: d1[k] - d0[k]
                          for k in ("hits", "misses", "bypass")},
         }
         if extra:
             record.update(extra)
+        _emit_overlap_metrics(overlap)
         self._closed_step_syncs += record["host_syncs"]
         self.records.append(record)
         if self.jsonl_path:
@@ -322,6 +456,10 @@ def fleet_step_summary(store, world_size: int, step: int,
         recs.append(json.loads(raw))
     durs = [rec["dur_s"] for rec in recs]
     slowest = max(range(world_size), key=lambda i: durs[i])
+    # overlap aggregate over ranks (records predating the overlap field
+    # contribute zeros)
+    fleet_overlap = aggregate_overlap(rec.get("overlap") or {}
+                                      for rec in recs)
     return {
         "step": step,
         "ranks": world_size,
@@ -334,6 +472,7 @@ def fleet_step_summary(store, world_size: int, step: int,
         "host_syncs": sum(rec["host_syncs"] for rec in recs),
         "comm_task_s": round(sum(t["dur_s"] for rec in recs
                                  for t in rec["comm_tasks"]), 6),
+        "overlap": fleet_overlap,
         "dispatch": {
             k: sum(rec["dispatch"][k] for rec in recs)
             for k in ("hits", "misses", "bypass")

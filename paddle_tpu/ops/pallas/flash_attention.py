@@ -53,11 +53,25 @@ __all__ = ["flash_attention_fwd", "flash_attention"]
 
 NEG_INF = -1e30
 # unshifted-softmax saturation bound: exact below, equal-weight above (see
-# module docstring); 2048-wide rows sum to <= 2e29 << f32 max
+# module docstring); 2048-wide rows sum to <= 2e29 << f32 max.
+#
+# The matching LOWER bound: f32 exp underflows to 0 for arguments below
+# ~-87.3 (ln(2^-126)), so in fast mode any key whose logit sits more than
+# ~87 below the row's lse contributes exactly 0 weight — in particular a
+# fully-masked row (all logits NEG_INF, l=0 -> lse=0 by the l_safe guard)
+# produces an all-zero output row rather than NaN. Between the two bounds
+# the unshifted form is exact; outside them it saturates (high side) or
+# truncates the tail (low side). PADDLE_TPU_FLASH_SAFE_SOFTMAX=1 selects
+# the running-max kernel, exact for any magnitude.
 _CLAMP = 60.0
 
 
 def _safe_softmax():
+    """Read the safe/fast softmax toggle. Captured ONCE per forward trace
+    (flash_attention_fwd) and threaded through the custom-VJP static args —
+    the backward must never re-read the env var, or a toggle between
+    forward and backward tracing silently corrupts gradients (the two
+    kernels disagree on the lse convention: running-max base vs 0)."""
     return os.environ.get("PADDLE_TPU_FLASH_SAFE_SOFTMAX") == "1"
 
 
@@ -236,11 +250,14 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, *rest_refs,
         lse_ref[0, 0] = base + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None, kbias=None):
+def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None, kbias=None,
+         safe=None):
     B, H, Sqp, D = q.shape
     _, Hkv, Skvp, _ = k.shape
     if bq is None or bk is None:
         bq, bk = _block_sizes(Sqp, Skvp, d=D)
+    if safe is None:
+        safe = _safe_softmax()
     nq = Sqp // bq
     nk = Skvp // bk
     group = H // Hkv
@@ -248,7 +265,7 @@ def _fwd(q, k, v, scale, causal, sq, skv, bq=None, bk=None, kbias=None):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, sq=sq, skv=skv,
-        bq=bq, bk=bk, nk=nk, safe=_safe_softmax(),
+        bq=bq, bk=bk, nk=nk, safe=safe,
         has_kbias=kbias is not None,
     )
     in_specs = [
@@ -293,7 +310,13 @@ def _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref=None):
     """One fused stream: s = q@kT (MXU) then exp(s - lse) (VPU). The fast
     forward clamps logits at _CLAMP, so its backward must clamp identically
     for gradient consistency. kb_ref: optional [1, bk] additive key bias
-    (padding mask) — folded in before the clamp like the forward."""
+    (padding mask) — folded in before the clamp like the forward.
+
+    Returns (p, ds_gate): ds_gate is None in safe mode; in fast mode it is
+    the boolean clamp mask — where the forward SATURATED (s >= _CLAMP),
+    d p/d s is exactly 0 (the clamp is flat), so ds must be zeroed there.
+    p itself stays ungated: dv = p^T @ do is correct with the saturated
+    weights."""
     s = jax.lax.dot_general(
         q_ref[0, 0], kt_ref[0, 0], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32
@@ -301,8 +324,9 @@ def _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref=None):
     if kb_ref is not None:
         s = s + kb_ref[0, :1].astype(jnp.float32)
     if not safe:
-        s = jnp.minimum(s, _CLAMP)
-    return jnp.exp(s - lse_ref[0, 0])
+        gate = s < _CLAMP
+        return jnp.exp(jnp.minimum(s, _CLAMP) - lse_ref[0, 0]), gate
+    return jnp.exp(s - lse_ref[0, 0]), None
 
 
 def _bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, *rest_refs, scale, causal,
@@ -324,7 +348,7 @@ def _bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, *rest_refs, scale, causal,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _accum(masked):
-        p = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref)
+        p, gate = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref)
         if masked:
             mask = _block_mask(q_start, k_start, bq, bk, off, causal, pad_k,
                                skv)
@@ -336,7 +360,10 @@ def _bwd_dq_kernel(q_ref, kt_ref, vt_ref, k_ref, *rest_refs, scale, causal,
             do, vt_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta_ref[0, 0]) * scale).astype(k_ref.dtype)
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        if gate is not None:  # fast path: zero ds where the clamp saturated
+            ds = jnp.where(gate, ds, 0.0)
+        ds = ds.astype(k_ref.dtype)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
@@ -398,7 +425,7 @@ def _bwd_dkv_kernel(q_ref, kt_ref, vt_ref, *rest_refs, scale, causal, sq,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _accum(masked):
-        p = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref)
+        p, gate = _recompute_p(q_ref, kt_ref, lse_ref, scale, safe, kb_ref)
         if masked:
             mask = _block_mask(q_start, k_start, bq, bk, off, causal, pad_k,
                                skv, pad_q=pad_q, sq=sq)
@@ -413,7 +440,10 @@ def _bwd_dkv_kernel(q_ref, kt_ref, vt_ref, *rest_refs, scale, causal, sq,
             do, vt_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta_ref[0, 0]) * scale).astype(q_ref.dtype)
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        if gate is not None:  # fast path: zero ds where the clamp saturated
+            ds = jnp.where(gate, ds, 0.0)
+        ds = ds.astype(q_ref.dtype)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32
@@ -459,17 +489,19 @@ def _bwd_dkv_kernel(q_ref, kt_ref, vt_ref, *rest_refs, scale, causal, sq,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk, kbias=None):
-    # (bq, bk) are the FORWARD's (possibly autotuned) block sizes, threaded
-    # through the VJP residuals — recomputing defaults here could diverge
-    # from the forward's padding and leave grid rows unwritten
+def _bwd(scale, causal, sq, skv, residuals, dout, bq, bk, safe,
+         kbias=None):
+    # (bq, bk, safe) are the FORWARD's (possibly autotuned) block sizes and
+    # softmax mode, threaded through the VJP's static args — recomputing
+    # them here could diverge from the forward (padding mismatch leaving
+    # grid rows unwritten; an env-var toggle flipping the lse convention
+    # between forward and backward, silently corrupting gradients)
     q, k, v, out, lse = residuals
     B, H, Sqp, D = q.shape
     _, Hkv, Skvp, _ = k.shape
     nq = Sqp // bq
     nk = Skvp // bk
     group = H // Hkv
-    safe = _safe_softmax()
     kt = jnp.swapaxes(k, 2, 3)  # [B, Hkv, D, Skv]
     vt = jnp.swapaxes(v, 2, 3)
 
@@ -562,20 +594,20 @@ def _pad_seq(x, block):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, bq, bk):
-    out, _ = _flash_fwd_res(q, k, v, causal, scale, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, bq, bk, safe):
+    out, _ = _flash_fwd_res(q, k, v, causal, scale, bq, bk, safe)
     return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_kb(q, k, v, kbias, causal, scale, bq, bk):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_kb(q, k, v, kbias, causal, scale, bq, bk, safe):
     """Variant with an additive per-key bias [B, Skv] (padding mask).
 
     The bias is treated as DATA: its cotangent is zero (callers with a
     trainable bias must use the composite path — the functional dispatch
     checks stop_gradient for exactly this)."""
-    out, _ = _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk)
+    out, _ = _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk, safe)
     return out
 
 
@@ -597,7 +629,7 @@ def _pad_kbias(kbias, skv, block):
     return kbias
 
 
-def _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk):
+def _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk, safe):
     B, H, Sq, D = q.shape
     Skv = k.shape[2]
     qp = _pad_seq(q, bq)
@@ -605,20 +637,21 @@ def _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk):
     vp = _pad_seq(v, bk)
     kbp = _pad_kbias(kbias.astype(jnp.float32), Skv, bk)
     out, lse = _fwd(qp, kp, vp, scale, causal, Sq, Skv, bq=bq, bk=bk,
-                    kbias=kbp)
+                    kbias=kbp, safe=safe)
     return out[:, :, :Sq], (qp, kp, vp, kbp, out, lse)
 
 
-def _flash_kb_vjp_fwd(q, k, v, kbias, causal, scale, bq, bk):
-    out, res = _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk)
+def _flash_kb_vjp_fwd(q, k, v, kbias, causal, scale, bq, bk, safe):
+    out, res = _flash_kb_fwd_res(q, k, v, kbias, causal, scale, bq, bk,
+                                 safe)
     return out, (res, q.shape[2], k.shape[2])
 
 
-def _flash_kb_vjp_bwd(causal, scale, bq, bk, saved, dout):
+def _flash_kb_vjp_bwd(causal, scale, bq, bk, safe, saved, dout):
     (qp, kp, vp, kbp, outp, lse), sq, skv = saved
     dop = jnp.pad(dout, ((0, 0), (0, 0), (0, qp.shape[2] - sq), (0, 0)))
     dq, dk, dv = _bwd(scale, causal, sq, skv, (qp, kp, vp, outp, lse), dop,
-                      bq, bk, kbias=kbp)
+                      bq, bk, safe, kbias=kbp)
     # the mask is data, not a trained parameter — zero cotangent; primal
     # kbias is f32 by construction (entry casts), so dtypes always match
     return (dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv],
@@ -654,26 +687,27 @@ def _tuned_blocks(q, k, v, causal, scale):
         signature=(B, H, k.shape[1], D, str(q.dtype), bool(causal)))
 
 
-def _flash_fwd_res(q, k, v, causal, scale, bq, bk):
+def _flash_fwd_res(q, k, v, causal, scale, bq, bk, safe):
     B, H, Sq, D = q.shape
     Skv = k.shape[2]
     qp = _pad_seq(q, bq)
     kp = _pad_seq(k, bk)
     vp = _pad_seq(v, bk)
-    out, lse = _fwd(qp, kp, vp, scale, causal, Sq, Skv, bq=bq, bk=bk)
+    out, lse = _fwd(qp, kp, vp, scale, causal, Sq, Skv, bq=bq, bk=bk,
+                    safe=safe)
     return out[:, :, :Sq], (qp, kp, vp, out, lse)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, bq, bk):
-    out, res = _flash_fwd_res(q, k, v, causal, scale, bq, bk)
+def _flash_vjp_fwd(q, k, v, causal, scale, bq, bk, safe):
+    out, res = _flash_fwd_res(q, k, v, causal, scale, bq, bk, safe)
     return out, (res, q.shape[2], k.shape[2])
 
 
-def _flash_vjp_bwd(causal, scale, bq, bk, saved, dout):
+def _flash_vjp_bwd(causal, scale, bq, bk, safe, saved, dout):
     (qp, kp, vp, outp, lse), sq, skv = saved
     dop = jnp.pad(dout, ((0, 0), (0, 0), (0, qp.shape[2] - sq), (0, 0)))
     dq, dk, dv = _bwd(scale, causal, sq, skv, (qp, kp, vp, outp, lse), dop,
-                      bq, bk)
+                      bq, bk, safe)
     return dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv]
 
 
@@ -697,12 +731,16 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, key_bias=None):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     bq, bk = _tuned_blocks(qt, kt, vt, causal, scale)
+    # softmax mode is captured HERE, at forward trace time, and rides the
+    # custom-VJP static args: fwd and bwd kernels always agree on the lse
+    # convention even if the env toggle flips between their traces
+    safe = _safe_softmax()
     if key_bias is not None:
         # f32 primal by construction: the zero cotangent in the VJP is f32
         out = _flash_kb(qt, kt, vt, key_bias.astype(jnp.float32), causal,
-                        scale, bq, bk)
+                        scale, bq, bk, safe)
     else:
-        out = _flash(qt, kt, vt, causal, scale, bq, bk)
+        out = _flash(qt, kt, vt, causal, scale, bq, bk, safe)
     return jnp.swapaxes(out, 1, 2)
 
 

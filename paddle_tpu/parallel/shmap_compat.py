@@ -1,0 +1,38 @@
+"""shard_map across jax generations.
+
+The manual-axes pipeline/ring code targets the jax >= 0.6 surface
+(`jax.shard_map(..., axis_names=..., check_vma=...)`). Older jaxlibs (0.4.x,
+still common on dev containers) only ship `jax.experimental.shard_map` with
+the inverse parameterization: `auto=` names the NON-manual axes and
+`check_rep` is the replication checker. One adapter keeps every call site on
+the new spelling so the compiled schedules don't fork per jax version.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    """`jax.shard_map` when available, else the 0.4.x experimental form.
+
+    `axis_names` is the MANUAL subset (new-jax semantics); None means every
+    mesh axis is manual. `check_vma` maps onto `check_rep` on old jax —
+    both gate the replication/varying-manual-axes checker.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=bool(check_vma), auto=auto)

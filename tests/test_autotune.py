@@ -100,9 +100,10 @@ def test_flash_entry_consults_tuner(monkeypatch):
     seen = []
     orig_fwd = fa._fwd
 
-    def spy(q, k, v, scale, causal, sq, skv, bq=None, bk=None):
+    def spy(q, k, v, scale, causal, sq, skv, bq=None, bk=None, safe=None):
         seen.append((bq, bk))
-        return orig_fwd(q, k, v, scale, causal, sq, skv, bq=bq, bk=bk)
+        return orig_fwd(q, k, v, scale, causal, sq, skv, bq=bq, bk=bk,
+                        safe=safe)
 
     monkeypatch.setattr(fa, "_fwd", spy)
     rng = np.random.default_rng(0)

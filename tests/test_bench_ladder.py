@@ -118,3 +118,47 @@ def test_adamw_moment_dtype_matches_f32_compute():
     ref = run(None)
     low = run("bfloat16")
     assert np.max(np.abs(ref - low)) < 1e-2, np.max(np.abs(ref - low))
+
+
+def test_timed_steps_emits_overlap_metrics(tmp_path):
+    """--emit-metrics acceptance: every step-timeline JSONL record carries
+    overlap_fraction, the perf line aggregates it, and
+    tools/overlap_report.py reads the file back."""
+    from paddle_tpu.observability import disable_step_timeline, \
+        enable_step_timeline
+
+    path = str(tmp_path / "bench_metrics.jsonl")
+    step, ids, labels = bench._decoder_step(_tiny_cfg(), 2, 16, False)
+    enable_step_timeline(jsonl_path=path)
+    try:
+        dt, info = bench._timed_steps(lambda: step(ids, labels), steps=3,
+                                      warmup=1, rung="cpu_smoke")
+    finally:
+        disable_step_timeline()
+    assert dt > 0
+    assert "overlap_fraction" in info
+    assert 0.0 <= info["overlap_fraction"] <= 1.0
+    assert "comm_exposed_s_per_step" in info
+
+    recs = [json.loads(ln) for ln in open(path)]
+    assert len(recs) == 3
+    assert all("overlap_fraction" in r for r in recs)
+    assert all(r["rung"] == "cpu_smoke" for r in recs)
+    # the distributed step instruments its input placement as comm
+    assert all(any(t["desc"] == "h2d/inputs" for t in r["comm_tasks"])
+               for r in recs)
+
+    from tools import overlap_report
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = overlap_report.main([path, "--json"])
+    assert rc == 0
+    summary = json.loads(buf.getvalue().strip())
+    assert summary["steps"] == 3
+    assert summary["overlap_fraction"] == pytest.approx(
+        info["overlap_fraction"], abs=1e-3)
+    assert "h2d/inputs" in summary["exposed_by_desc"] or \
+        summary["exposed_s"] == 0.0

@@ -551,6 +551,171 @@ class TestFitTelemetry:
         assert registry.get("hapi_loss_sync_total").value() == 4  # 3 logs + epoch mean
         assert registry.get("hapi_train_steps_total").value() == 6
         assert registry.get("hapi_train_step_seconds").count() == 6
-        # fit's spans are in the step records
-        assert recs[0]["spans"][0]["name"] == "fit/train_batch"
+        # fit's spans are in the step records (inner spans exit first, so
+        # the compiled-step compute span precedes the fit wrapper)
+        names = [s["name"] for s in recs[0]["spans"]]
+        assert "fit/train_batch" in names
+        assert "fit/train_batch/train_step/compiled" in names
         rt.reset_runtime_events()
+
+
+# --------------------------------------------------------------------------- #
+# comm/compute overlap (ROADMAP item 2: the T3-style tracked-overlap metric)
+# --------------------------------------------------------------------------- #
+
+
+def _ct(start_s, dur_s, desc="rs", kind="comm"):
+    return {"desc": desc, "kind": kind, "start_ns": int(start_s * 1e9),
+            "dur_s": dur_s}
+
+
+def _sp(start_s, dur_s, kind="compute", name="bwd"):
+    rec = {"name": name, "depth": 0, "start_ns": int(start_s * 1e9),
+           "dur_s": dur_s}
+    if kind is not None:
+        rec["attrs"] = {"kind": kind}
+    return rec
+
+
+class TestOverlapStats:
+    def test_disjoint_comm_fully_exposed(self):
+        ov = spans.overlap_stats([_ct(0.0, 0.1)], [_sp(0.2, 0.1)])
+        assert ov["fraction"] == 0.0
+        assert ov["comm_s"] == pytest.approx(0.1)
+        assert ov["exposed_s"] == pytest.approx(0.1)
+        assert ov["covered_s"] == 0.0
+
+    def test_fully_covered_comm(self):
+        ov = spans.overlap_stats([_ct(0.1, 0.1)], [_sp(0.0, 0.5)])
+        assert ov["fraction"] == 1.0
+        assert ov["exposed_s"] == 0.0
+
+    def test_partial_overlap_exact_interval_math(self):
+        # comm [0, 0.4); compute [0.3, 0.6) -> covered 0.1 of 0.4
+        ov = spans.overlap_stats([_ct(0.0, 0.4)], [_sp(0.3, 0.3)])
+        assert ov["fraction"] == pytest.approx(0.25)
+        assert ov["covered_s"] == pytest.approx(0.1)
+        assert ov["exposed_s"] == pytest.approx(0.3)
+
+    def test_zero_comm_step_reports_one(self):
+        ov = spans.overlap_stats([], [_sp(0.0, 1.0)])
+        assert ov == {"fraction": 1.0, "comm_s": 0.0, "covered_s": 0.0,
+                      "exposed_s": 0.0}
+
+    def test_union_not_pairwise_sum(self):
+        # two overlapping comm intervals: union is 0.3, not 0.4; two
+        # overlapping compute spans covering [0.0, 0.25) -> covered 0.25
+        comm = [_ct(0.0, 0.2), _ct(0.1, 0.2)]
+        compute = [_sp(0.0, 0.15), _sp(0.1, 0.15)]
+        ov = spans.overlap_stats(comm, compute)
+        assert ov["comm_s"] == pytest.approx(0.3)
+        assert ov["covered_s"] == pytest.approx(0.25)
+        assert ov["fraction"] == pytest.approx(0.25 / 0.3)
+
+    def test_step_kind_and_untagged_spans_excluded(self):
+        # a deadline-only "step" region is not comm; an untagged (driver)
+        # span wrapping everything is not compute
+        comm = [_ct(0.0, 1.0, desc="train_step/3", kind="step"),
+                _ct(0.2, 0.1)]
+        compute = [_sp(0.0, 1.0, kind=None, name="fit/train_batch")]
+        ov = spans.overlap_stats(comm, compute)
+        assert ov["comm_s"] == pytest.approx(0.1)
+        assert ov["fraction"] == 0.0
+
+    def test_multi_interval_sweep(self):
+        comm = [_ct(0.0, 0.1), _ct(0.2, 0.1), _ct(0.4, 0.1)]
+        compute = [_sp(0.05, 0.2), _sp(0.45, 0.2)]
+        ov = spans.overlap_stats(comm, compute)
+        # covered: [0.05,0.1)=0.05 + [0.2,0.25)=0.05 + [0.45,0.5)=0.05
+        assert ov["covered_s"] == pytest.approx(0.15)
+        assert ov["fraction"] == pytest.approx(0.5)
+
+
+class TestOverlapTimeline:
+    def test_record_carries_overlap_and_metrics(self, timeline, registry):
+        timeline.step_begin(0)
+        with comm_watchdog.comm_task("rs/grads"):
+            with obs.span("update", kind="compute"):
+                time.sleep(0.01)
+        rec = timeline.step_end()
+        assert rec["overlap_fraction"] == rec["overlap"]["fraction"]
+        assert rec["overlap"]["comm_s"] >= 0.01
+        # the comm region is covered by the concurrent compute span
+        assert rec["overlap_fraction"] > 0.5
+        assert registry.get("step_overlap_fraction").value() == \
+            rec["overlap_fraction"]
+        assert registry.get("comm_overlapped_seconds_total").value() == \
+            pytest.approx(rec["overlap"]["covered_s"])
+
+    def test_exposed_comm_counted(self, timeline, registry):
+        timeline.step_begin(1)
+        with comm_watchdog.comm_task("allgather/params"):
+            time.sleep(0.01)
+        rec = timeline.step_end()
+        assert rec["overlap_fraction"] == 0.0
+        assert registry.get("comm_exposed_seconds_total").value() == \
+            pytest.approx(rec["overlap"]["exposed_s"])
+
+    def test_overlap_fraction_in_every_jsonl_record(self, registry,
+                                                    tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        tl = obs.enable_step_timeline(jsonl_path=path)
+        try:
+            for i in range(3):
+                tl.step_begin(i)
+                if i == 1:
+                    with comm_watchdog.comm_task("ar"):
+                        time.sleep(0.002)
+                tl.step_end()
+        finally:
+            tl.uninstall()
+        recs = [json.loads(ln) for ln in open(path)]
+        assert all("overlap_fraction" in r and "overlap" in r for r in recs)
+        assert recs[0]["overlap_fraction"] == 1.0  # zero-comm step
+        assert recs[1]["overlap_fraction"] == 0.0  # exposed comm
+
+    def test_flight_records_carry_overlap(self, timeline, recorder,
+                                          tmp_path):
+        timeline.step_begin(5)
+        timeline.step_end()
+        path = recorder.dump(path=str(tmp_path / "flight.json"),
+                             reason="test")
+        doc = json.loads(open(path).read().strip().splitlines()[-1])
+        steps = doc["steps"]
+        assert steps and all("overlap_fraction" in r for r in steps)
+
+    def test_fleet_summary_aggregates_overlap(self, registry):
+        class FakeStore:
+            def __init__(self):
+                self.kv = {}
+
+            def set(self, k, v):
+                self.kv[k] = v.encode() if isinstance(v, str) else v
+
+            def tryget(self, k):
+                return self.kv.get(k)
+
+        store = FakeStore()
+        base = {"sync_kinds": {}, "comm_tasks": [], "spans": [],
+                "dispatch": {"hits": 0, "misses": 0, "bypass": 0},
+                "t_wall": 0.0, "host_syncs": 0}
+        obs.publish_step_record(store, 0, {
+            **base, "step": 1, "dur_s": 0.2,
+            "overlap": {"fraction": 1.0, "comm_s": 0.1, "covered_s": 0.1,
+                        "exposed_s": 0.0}})
+        obs.publish_step_record(store, 1, {
+            **base, "step": 1, "dur_s": 0.2,
+            "overlap": {"fraction": 0.0, "comm_s": 0.1, "covered_s": 0.0,
+                        "exposed_s": 0.1}})
+        s = obs.fleet_step_summary(store, world_size=2, step=1)
+        assert s["overlap"]["fraction"] == pytest.approx(0.5)
+        assert s["overlap"]["comm_s"] == pytest.approx(0.2)
+        assert s["overlap"]["exposed_s"] == pytest.approx(0.1)
+
+    def test_comm_task_start_offset_relative_to_step(self, timeline):
+        timeline.step_begin(0)
+        time.sleep(0.005)
+        with comm_watchdog.comm_task("late"):
+            pass
+        rec = timeline.step_end()
+        assert rec["comm_tasks"][0]["start_ns"] >= 4_000_000

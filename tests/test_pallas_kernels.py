@@ -194,3 +194,72 @@ class TestKeyBiasPath:
                              causal=False)
         err = np.abs(out.numpy() - np.asarray(ref)).max()
         assert err < 2e-5, err
+
+
+class TestSafeSoftmaxToggle:
+    """ADVICE r5: PADDLE_TPU_FLASH_SAFE_SOFTMAX used to be re-read at
+    backward TRACE time, so flipping it between forward and backward
+    silently corrupted gradients (the two kernels disagree on the lse
+    convention). The mode is now captured at forward trace time and rides
+    the custom-VJP static args."""
+
+    def _qkv(self, seed=0, B=1, S=64, H=2, D=32):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+        return q, k, v
+
+    def _grads(self, q, k, v):
+        out, vjp = jax.vjp(
+            lambda a, b, c: flash_attention_fwd(a, b, c, causal=True),
+            q, k, v)
+        return out, vjp(jnp.ones_like(out))
+
+    @pytest.mark.parametrize("fwd_mode", ["0", "1"])
+    def test_env_flip_between_fwd_and_bwd_is_inert(self, monkeypatch,
+                                                   fwd_mode):
+        q, k, v = self._qkv()
+        bwd_mode = "1" if fwd_mode == "0" else "0"
+        # reference: both passes in the forward's mode
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SAFE_SOFTMAX", fwd_mode)
+        ref_out, ref_grads = self._grads(q, k, v)
+        # toggled run: vjp built under fwd_mode, env flipped before the
+        # backward trace executes
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SAFE_SOFTMAX", fwd_mode)
+        out, vjp = jax.vjp(
+            lambda a, b, c: flash_attention_fwd(a, b, c, causal=True),
+            q, k, v)
+        monkeypatch.setenv("PADDLE_TPU_FLASH_SAFE_SOFTMAX", bwd_mode)
+        grads = vjp(jnp.ones_like(out))
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-6)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(g, rg, rtol=1e-4, atol=1e-5,
+                                       err_msg="backward followed the env "
+                                       "var, not the forward's mode")
+
+    def test_fast_mode_gates_ds_at_clamp(self):
+        """Where the fast forward SATURATED (all logits >= _CLAMP), the
+        clamp is flat so dq and dk must be exactly zero; dv (which sees the
+        saturated equal weights) stays finite — the safe kernel is the
+        oracle for it."""
+        rng = np.random.default_rng(1)
+        B, S, H, D = 1, 16, 1, 32
+        # logits = (q @ k^T) * scale, driven far above _CLAMP=60 everywhere
+        q = jnp.asarray(100.0 * np.abs(rng.standard_normal((B, S, H, D))),
+                        jnp.float32)
+        k = jnp.asarray(np.abs(rng.standard_normal((B, S, H, D))),
+                        jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+        logits = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+        assert logits.min() > 60.0  # every entry saturates
+
+        os.environ.pop("PADDLE_TPU_FLASH_SAFE_SOFTMAX", None)
+        out, (gq, gk, gv) = (
+            lambda o, vjp: (o, vjp(jnp.ones_like(o))))(*jax.vjp(
+                lambda a, b, c: flash_attention_fwd(a, b, c, causal=False),
+                q, k, v))
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(gq, np.zeros_like(gq), atol=1e-6)
+        np.testing.assert_allclose(gk, np.zeros_like(gk), atol=1e-6)
+        assert np.all(np.isfinite(gv)) and np.abs(gv).max() > 0

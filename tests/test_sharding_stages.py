@@ -128,8 +128,13 @@ def test_stage2_sharded_update_in_program():
 
 
 def test_offload_states_stay_on_host():
-    """offload=True keeps optimizer states in pinned host memory across
-    steps (reference: GroupSharded offload=True moving moments to CPU)."""
+    """offload=True keeps optimizer states in host memory across steps
+    (reference: GroupSharded offload=True moving moments to CPU). The
+    memory kind is per-platform: pinned_host on TPU, unpinned_host on the
+    CPU backend (where host==device memory, the same code path runs as a
+    no-op placement)."""
+    from paddle_tpu.distributed.train_step import host_memory_kind
+
     losses, step = _run(2, steps=3, offload=True)
     ref, _ = _run(2, steps=3)
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-5)
@@ -138,7 +143,138 @@ def test_offload_states_stay_on_host():
         for d in step.opt_states.values()
         for v in d.values() if hasattr(v, "sharding")
     }
-    assert kinds == {"pinned_host"}, kinds
+    assert kinds == {host_memory_kind(step.mesh)}, kinds
+
+
+def test_offload_streaming_vs_move_barrier_parity():
+    """The comm_overlap streaming path (in-program per-param device_puts)
+    and the legacy host-side move barrier must produce the same training
+    trajectory — they only relocate WHERE the transfers are issued."""
+    paddle.seed(0)
+    mesh = dist.build_mesh(sharding=4)
+    x, y = _data()
+
+    def run(overlap):
+        paddle.seed(0)
+        model = _MLP()
+        crit = nn.MSELoss()
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = dist.DistributedTrainStep(
+            model, lambda o, t: crit(o, t), optimizer, mesh=mesh,
+            sharding_stage=2, offload=True, comm_overlap=overlap)
+        losses = [float(step(x, y)) for _ in range(3)]
+        dist.env.set_global_mesh(None)
+        return losses, step
+
+    on, step_on = run(True)
+    off, step_off = run(False)
+    np.testing.assert_allclose(on, off, rtol=0, atol=0)
+    assert step_on._offload_streaming()
+    assert not step_off._offload_streaming()  # knob off -> move barrier
+
+
+def test_grad_bucket_tags_keep_stage2_parity():
+    """In-backward reduce-scatter bucket tags (comm_overlap, stage 2) are
+    identities on the primals and only constrain cotangent placement —
+    the loss trajectory must be unchanged, and the plan must actually
+    cover the sharded params in reverse topological order."""
+    x, y = _data()
+
+    def run(overlap, bucket_mb=None):
+        paddle.seed(0)
+        model = _MLP()
+        crit = nn.MSELoss()
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        mesh = dist.build_mesh(sharding=4)
+        step = dist.DistributedTrainStep(
+            model, lambda o, t: crit(o, t), optimizer, mesh=mesh,
+            sharding_stage=2, comm_overlap=overlap)
+        if bucket_mb is not None:
+            import os as _os
+            _os.environ["PADDLE_TPU_RS_BUCKET_MB"] = str(bucket_mb)
+        try:
+            losses = [float(step(x, y)) for _ in range(3)]
+            plan = step._grad_bucket_plan()
+        finally:
+            if bucket_mb is not None:
+                del _os.environ["PADDLE_TPU_RS_BUCKET_MB"]
+        dist.env.set_global_mesh(None)
+        return losses, plan, step
+
+    on, plan_on, step_on = run(True)
+    off, plan_off, _ = run(False)
+    np.testing.assert_allclose(on, off, rtol=0, atol=0)
+    assert plan_off == []
+    tagged = [n for names, _ in plan_on for n in names]
+    sharded = [n for n in step_on._state.params
+               if step_on._update_spec(n) != step_on._param_spec(n)]
+    assert sorted(tagged) == sorted(sharded)
+    # reverse topological order: last-registered param's grad arrives first
+    assert tagged == list(reversed([n for n in step_on._state.params
+                                    if n in set(tagged)]))
+    # a tiny bucket cap splits the plan into more buckets, same coverage
+    _, plan_small, _ = run(True, bucket_mb=1e-4)
+    assert len(plan_small) > len(plan_on)
+    assert sorted(n for names, _ in plan_small for n in names) == \
+        sorted(tagged)
+
+
+def test_h2d_pipelined_behind_inflight_step():
+    """When the previous step's program is still executing at input-
+    placement time, the h2d window is recorded as overlapped comm (the
+    train_step/prev_step_inflight compute span) — the T3 'tracked
+    overlap' signal the schedule work optimizes."""
+    from paddle_tpu import observability as obs
+
+    paddle.seed(0)
+    mesh = dist.build_mesh(sharding=4)
+    model = _MLP()
+    crit = nn.MSELoss()
+    optimizer = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = dist.DistributedTrainStep(
+        model, lambda o, t: crit(o, t), optimizer, mesh=mesh,
+        sharding_stage=2, comm_overlap=True)
+    x, y = _data()
+    _ = float(step(x, y))  # compile
+
+    class _Inflight:  # deterministic "previous step still executing"
+        def is_ready(self):
+            return False
+
+    tl = obs.enable_step_timeline()
+    try:
+        step._inflight = _Inflight()
+        tl.step_begin(0)
+        _ = step(x, y)
+        rec = tl.step_end()
+    finally:
+        tl.uninstall()
+        dist.env.set_global_mesh(None)
+    names = [s["name"] for s in rec["spans"]]
+    assert any(n.endswith("prev_step_inflight") for n in names), names
+    # and the h2d comm interval is credited as covered
+    assert rec["overlap"]["covered_s"] > 0
+    assert rec["overlap_fraction"] > 0
+
+    # knob off: the same window is exposed comm
+    model_off = _MLP()
+    step_off = dist.DistributedTrainStep(
+        model_off, lambda o, t: crit(o, t),
+        opt.AdamW(learning_rate=1e-3, parameters=model_off.parameters()),
+        mesh=mesh, sharding_stage=0, comm_overlap=False)
+    tl = obs.enable_step_timeline()
+    try:
+        step_off._inflight = _Inflight()
+        tl.step_begin(1)
+        _ = step_off(x, y)
+        rec_off = tl.step_end()
+    finally:
+        tl.uninstall()
+        dist.env.set_global_mesh(None)
+    assert not any(n.endswith("prev_step_inflight")
+                   for n in (s["name"] for s in rec_off["spans"]))
 
 
 def test_group_sharded_parallel_plumbs_stage():

@@ -1,0 +1,168 @@
+"""Per-step comm/compute overlap report from step-timeline JSONL.
+
+Reads the records `bench.py --emit-metrics` / `enable_step_timeline(
+jsonl_path=...)` append (one JSON object per training step) and prints the
+overlap picture the scheduling work targets: per-step `overlap_fraction`,
+the comm/covered/exposed interval-union seconds behind it, and which comm
+regions the exposed time belongs to.
+
+    python -m tools.overlap_report bench_metrics.jsonl
+    python -m tools.overlap_report steps.jsonl --rung gpt3_125m --per-step
+    python -m tools.overlap_report before.jsonl after.jsonl   # A/B delta
+
+Records written before the overlap field existed are re-derived from their
+interval lists when possible (`spans.overlap_stats` is pure), so old JSONL
+still reports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.observability.spans import (  # noqa: E402
+    _intersect_len,
+    _merge_intervals,
+    aggregate_overlap,
+    overlap_stats,
+)
+
+
+def load_records(path, rung=None):
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "comm_tasks" not in rec and "overlap" not in rec:
+                continue  # not a step record (e.g. metric export lines)
+            if rung and rec.get("rung") != rung:
+                continue
+            recs.append(rec)
+    return recs
+
+
+def record_overlap(rec):
+    ov = rec.get("overlap")
+    if ov is None:
+        ov = overlap_stats(rec.get("comm_tasks", []), rec.get("spans", []))
+    return ov
+
+
+def exposed_by_desc(rec):
+    """Exposed seconds per comm_task desc: each comm region's own interval
+    minus its intersection with the step's compute-span union."""
+    compute = _merge_intervals(
+        (s.get("start_ns", 0) / 1e9,
+         s.get("start_ns", 0) / 1e9 + s.get("dur_s", 0.0))
+        for s in rec.get("spans", [])
+        if (s.get("attrs") or {}).get("kind") == "compute")
+    out = {}
+    for t in rec.get("comm_tasks", []):
+        if t.get("kind", "comm") != "comm":
+            continue
+        s = t.get("start_ns", 0) / 1e9
+        iv = [(s, s + t.get("dur_s", 0.0))]
+        exposed = t.get("dur_s", 0.0) - _intersect_len(iv, compute)
+        if exposed > 0:
+            out[t["desc"]] = out.get(t["desc"], 0.0) + exposed
+    return out
+
+
+def summarize(recs):
+    ovs = [record_overlap(r) for r in recs]
+    agg = aggregate_overlap(ovs)
+    fracs = [o["fraction"] for o in ovs]
+    by_desc = {}
+    for r in recs:
+        for desc, s in exposed_by_desc(r).items():
+            by_desc[desc] = by_desc.get(desc, 0.0) + s
+    return {
+        "steps": len(recs),
+        "overlap_fraction": round(agg["fraction"], 4),
+        "fraction_min": round(min(fracs), 4) if fracs else 1.0,
+        "fraction_mean": round(sum(fracs) / len(fracs), 4) if fracs else 1.0,
+        "comm_s": agg["comm_s"],
+        "covered_s": agg["covered_s"],
+        "exposed_s": agg["exposed_s"],
+        "exposed_by_desc": {
+            k: round(v, 6)
+            for k, v in sorted(by_desc.items(), key=lambda kv: -kv[1])
+        },
+    }
+
+
+def print_summary(path, summary, top):
+    print(f"== {path}: {summary['steps']} steps ==")
+    print(f"  overlap_fraction {summary['overlap_fraction']:.4f} "
+          f"(mean {summary['fraction_mean']:.4f}, "
+          f"min {summary['fraction_min']:.4f})")
+    print(f"  comm {summary['comm_s'] * 1e3:.3f} ms  "
+          f"covered {summary['covered_s'] * 1e3:.3f} ms  "
+          f"exposed {summary['exposed_s'] * 1e3:.3f} ms")
+    items = list(summary["exposed_by_desc"].items())[:top]
+    if items:
+        print("  exposed comm by region:")
+        for desc, s in items:
+            print(f"    {desc:<32} {s * 1e3:10.3f} ms")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="comm/compute overlap breakdown from step-timeline JSONL")
+    ap.add_argument("paths", nargs="+", help="step-timeline JSONL file(s); "
+                    "two files print an A/B delta")
+    ap.add_argument("--rung", help="only records tagged with this bench rung")
+    ap.add_argument("--per-step", action="store_true",
+                    help="one line per step record")
+    ap.add_argument("--top", type=int, default=8,
+                    help="exposed-comm regions to list (default 8)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable: one summary JSON line per file")
+    args = ap.parse_args(argv)
+
+    summaries = []
+    for path in args.paths:
+        recs = load_records(path, rung=args.rung)
+        if not recs:
+            print(f"== {path}: no step records"
+                  + (f" for rung {args.rung!r}" if args.rung else ""),
+                  file=sys.stderr)
+            summaries.append(None)
+            continue
+        s = summarize(recs)
+        summaries.append(s)
+        if args.json:
+            print(json.dumps({"path": path, **s}, sort_keys=True))
+        else:
+            print_summary(path, s, args.top)
+            if args.per_step:
+                for r in recs:
+                    ov = record_overlap(r)
+                    tag = f" rung={r['rung']}" if r.get("rung") else ""
+                    print(f"  step {r.get('step', '?'):>4}{tag} "
+                          f"dur {r.get('dur_s', 0) * 1e3:8.3f} ms  "
+                          f"overlap {ov['fraction']:.4f}  "
+                          f"exposed {ov['exposed_s'] * 1e3:8.3f} ms")
+    if len(args.paths) == 2 and all(summaries):
+        a, b = summaries
+        print(f"== delta ({args.paths[1]} vs {args.paths[0]}) ==")
+        print(f"  overlap_fraction {a['overlap_fraction']:.4f} -> "
+              f"{b['overlap_fraction']:.4f} "
+              f"({b['overlap_fraction'] - a['overlap_fraction']:+.4f})")
+        print(f"  exposed per step {a['exposed_s'] / max(a['steps'], 1) * 1e3:.3f}"
+              f" -> {b['exposed_s'] / max(b['steps'], 1) * 1e3:.3f} ms")
+    return 0 if any(summaries) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
