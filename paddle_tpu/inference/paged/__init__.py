@@ -1,0 +1,26 @@
+"""Paged-KV serving subsystem (docs/SERVING.md).
+
+- `BlockPool` — fixed-size physical KV pages in the layout the Pallas
+  `paged_decode_attention` kernel consumes, with free-list allocation,
+  refcounted prefix sharing and copy-on-write.
+- `TwoQueueScheduler` — power-of-two prefill length buckets + decode/resume
+  queues, admitting against a page-budget watermark.
+- `PagedServingEngine` — the continuous-batching engine over both, with
+  preemption to a host spill buffer and SLO metrics through the
+  observability registry.
+
+The dense `ContinuousBatchingEngine` remains the fallback:
+`paddle_tpu.inference.create_serving_engine(model, paged=False)`.
+"""
+
+from .block_pool import BlockPool, prefix_page_key
+from .engine import PagedServingEngine, SpilledRequest
+from .scheduler import TwoQueueScheduler
+
+__all__ = [
+    "BlockPool",
+    "PagedServingEngine",
+    "SpilledRequest",
+    "TwoQueueScheduler",
+    "prefix_page_key",
+]

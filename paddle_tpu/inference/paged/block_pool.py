@@ -1,0 +1,204 @@
+"""Block-pool KV cache manager: fixed-size physical pages, free-list
+allocation, refcounted prefix sharing, copy-on-write.
+
+The physical layout is `[n_pages, Hkv, page_size, D]` per layer — exactly
+the shape `ops.pallas.decode_attention.paged_decode_attention` consumes, so
+the decode program DMAs pages straight from their physical slots (the block
+table is a scalar-prefetch operand resolved in the BlockSpec index_map; no
+gathered copy of the cache ever materializes).
+
+Host-side metadata (free list, refcounts, prefix map) is plain Python/numpy:
+it is touched once per admission / page-boundary crossing / preemption, never
+per token, and never inside a trace. Device arrays are immutable jnp values;
+every mutation (`.at[...]`) swaps in a fresh array, which composes with the
+engine's donated decode program.
+
+Prefix sharing: a prompt page is keyed by the hash of the ENTIRE token
+prefix through that page's end — K/V at position i depends on every token
+<= i (attention mixes the prefix into the hidden state before the
+projections), so two pages are interchangeable iff their full prefixes
+match. Partial tail pages therefore only share between prompts with
+identical full prefixes of the same length; extending a shorter prompt's
+tail page in place is deliberately out of scope (vLLM's partial-block
+dedup), see docs/SERVING.md. A shared page is immutable: the engine must
+copy-on-write (`copy_page`) before the first divergent write, and a page
+that stops being shared (refcount 1) must be unregistered before an
+in-place write so a later identical prompt cannot adopt a page that now
+holds generated tokens.
+
+Physical page 0 is the reserved NULL page: never allocated, never referenced
+by a live block table. Parked decode rows (batch padding) route their
+per-step K/V writes there, so the fixed-shape decode program needs no
+conditional writes.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..slo import serving_metrics
+
+__all__ = ["BlockPool", "prefix_page_key"]
+
+
+def prefix_page_key(prompt: np.ndarray, page_index: int, page_size: int):
+    """Sharing key for prompt page `page_index`: hash of the full token
+    prefix through the page's end (clipped to the prompt length)."""
+    end = min(len(prompt), (page_index + 1) * page_size)
+    return hashlib.blake2b(
+        np.ascontiguousarray(prompt[:end], np.int32).tobytes(),
+        digest_size=16).digest()
+
+
+class BlockPool:
+    """Fixed pool of physical KV pages shared by every layer's cache."""
+
+    def __init__(self, num_layers, kv_heads, head_dim, page_size, num_pages,
+                 dtype=jnp.float32, prefix_sharing=True):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.num_layers = int(num_layers)
+        self.prefix_sharing = bool(prefix_sharing)
+        shape = (self.num_pages, kv_heads, self.page_size, head_dim)
+        # immutable jnp zeros: (z,)*2 aliasing is safe, .at[] copies
+        self.kv = [(jnp.zeros(shape, jnp.dtype(dtype)),) * 2
+                   for _ in range(num_layers)]
+        self.free: collections.deque = collections.deque(
+            range(1, self.num_pages))
+        self.ref = np.zeros(self.num_pages, np.int32)
+        self._prefix: dict[bytes, int] = {}   # key -> page
+        self._page_key: dict[int, bytes] = {}  # page -> key (registered only)
+        self.allocs_total = 0  # lifetime allocations (tests/introspection)
+
+    # -- accounting ------------------------------------------------------ #
+
+    @property
+    def pages_total(self) -> int:
+        return self.num_pages - 1  # null page is not allocatable
+
+    @property
+    def pages_free(self) -> int:
+        return len(self.free)
+
+    def update_gauges(self):
+        m = serving_metrics()
+        m["pages_free"].set(self.pages_free)
+        m["pages_total"].set(self.pages_total)
+
+    # -- allocation / refcounts ------------------------------------------ #
+
+    def alloc(self) -> int | None:
+        """One free page with refcount 1, or None when the pool is dry."""
+        if not self.free:
+            return None
+        page = self.free.popleft()
+        self.ref[page] = 1
+        self.allocs_total += 1
+        return page
+
+    def incref(self, page: int):
+        assert self.ref[page] > 0, f"incref on unallocated page {page}"
+        self.ref[page] += 1
+
+    def release(self, page: int):
+        """Drop one reference; a page at zero is unregistered and freed."""
+        assert self.ref[page] > 0, f"release of unallocated page {page}"
+        self.ref[page] -= 1
+        if self.ref[page] == 0:
+            self.unregister_page(page)
+            self.free.append(page)
+
+    def is_shared(self, page: int) -> bool:
+        return self.ref[page] > 1
+
+    # -- prefix sharing -------------------------------------------------- #
+
+    def lookup_prefix(self, key: bytes | None) -> int | None:
+        """Shared page for `key` (increfs on hit), else None."""
+        if not self.prefix_sharing or key is None:
+            return None
+        m = serving_metrics()
+        m["prefix_lookups"].inc()
+        page = self._prefix.get(key)
+        if page is None:
+            return None
+        self.incref(page)
+        m["prefix_hits"].inc()
+        return page
+
+    def register_prefix(self, key: bytes, page: int):
+        if not self.prefix_sharing or key in self._prefix:
+            return
+        self._prefix[key] = page
+        self._page_key[page] = key
+
+    def is_registered(self, page: int) -> bool:
+        return page in self._page_key
+
+    def page_key(self, page: int) -> bytes | None:
+        return self._page_key.get(page)
+
+    def unregister_page(self, page: int):
+        """Remove a page from the prefix map (before an in-place write, or
+        on free) so future lookups cannot adopt diverged content."""
+        key = self._page_key.pop(page, None)
+        if key is not None:
+            self._prefix.pop(key, None)
+
+    # -- device page data ------------------------------------------------ #
+
+    def write_prompt_pages(self, pages, write_mask, k_layers, v_layers):
+        """Scatter a prefilled prompt into its pages, all layers.
+
+        pages: the request's m physical pages in logical order; write_mask[j]
+        False for shared pages (content already present — identical by key
+        construction, so it is never rewritten). k_layers/v_layers: per layer
+        [m, Hkv, page_size, D] page-stacked prompt K/V. One batched scatter
+        per layer per side."""
+        idx = [j for j, w in enumerate(write_mask) if w]
+        if not idx:
+            return
+        tgt = jnp.asarray([pages[j] for j in idx], jnp.int32)
+        sel = jnp.asarray(idx, jnp.int32)
+        for li in range(self.num_layers):
+            k, v = self.kv[li]
+            self.kv[li] = (k.at[tgt].set(k_layers[li][sel]),
+                           v.at[tgt].set(v_layers[li][sel]))
+
+    def copy_page(self, src: int, dst: int):
+        """Copy-on-write body: duplicate src's content into dst (all
+        layers). Caller owns refcount/table updates."""
+        for li in range(self.num_layers):
+            k, v = self.kv[li]
+            self.kv[li] = (k.at[dst].set(k[src]), v.at[dst].set(v[src]))
+        serving_metrics()["cow_copies"].inc()
+
+    def read_pages(self, pages) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Host copies of the given pages, per layer: [(k, v), ...] each
+        [m, Hkv, page_size, D] — the preemption spill buffer."""
+        idx = jnp.asarray(list(pages), jnp.int32)
+        return [(np.asarray(k[idx]), np.asarray(v[idx]))
+                for k, v in self.kv]
+
+    def restore_pages(self, pages, kv_host, rows):
+        """Write spilled host pages back: kv_host is read_pages() output for
+        the request's full logical page list; `rows` selects which logical
+        indices need restoring (prefix-shared hits don't), `pages` the
+        freshly allocated physical destinations, aligned with `rows`."""
+        if not pages:
+            return
+        tgt = jnp.asarray(list(pages), jnp.int32)
+        sel = np.asarray(list(rows), np.int32)
+        for li in range(self.num_layers):
+            k, v = self.kv[li]
+            k_h, v_h = kv_host[li]
+            self.kv[li] = (k.at[tgt].set(jnp.asarray(k_h[sel])),
+                           v.at[tgt].set(jnp.asarray(v_h[sel])))

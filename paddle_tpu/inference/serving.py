@@ -10,18 +10,30 @@ recompiles. Prefill pads prompts to power-of-two length buckets to bound
 compile count. This is the vLLM/fastdeploy scheduling idea expressed as
 static shapes + masking instead of dynamic batch reshaping — the form XLA
 wants.
+
+Two engines share the scaffolding in `_ServingEngineBase`:
+
+- `ContinuousBatchingEngine` (this module) — dense per-slot KV caches,
+  every slot reserves max_seq_len rows of HBM. Simple, and the fallback
+  (`inference.create_serving_engine(..., paged=False)`).
+- `PagedServingEngine` (`paddle_tpu.inference.paged`) — block-pool paged
+  KV cache with prefix sharing, preemption and a two-queue scheduler; HBM
+  is allocated per page actually used, not per slot capacity. See
+  docs/SERVING.md.
 """
 
 from __future__ import annotations
 
 import collections
 import itertools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor
+from .slo import BoundedCompileCache, serving_metrics
 
 __all__ = ["GenerationRequest", "ContinuousBatchingEngine"]
 
@@ -32,14 +44,23 @@ class GenerationRequest:
     _ids = itertools.count()
 
     def __init__(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-                 eos_token_id=None):
+                 eos_token_id=None, priority=0):
         self.req_id = next(self._ids)
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
+        # scheduling weight: higher survives preemption longer (paged engine)
+        self.priority = int(priority)
         self.generated: list[int] = []
         self.done = False
+        # True iff the engine retired this request because the KV cache hit
+        # max_seq_len before max_new_tokens/EOS — the output is shorter than
+        # asked for (previously this truncation was silent)
+        self.truncated = False
+        self._t_arrival = time.perf_counter()
+        self._t_first: float | None = None
+        self._sample_key = None  # set by the admitting engine
 
     @property
     def output_ids(self):
@@ -54,22 +75,171 @@ def _bucket(n):
     return b
 
 
-class ContinuousBatchingEngine:
-    """Admit-while-decoding scheduler over a slotted KV cache.
+class _ServingEngineBase:
+    """Model state, bucketed prefill compilation, sampling and SLO
+    bookkeeping shared by the dense and paged engines. Subclasses own the
+    KV representation and the admission policy."""
+
+    engine_label = "base"
+
+    def __init__(self, model, max_batch_size=8, max_seq_len=512, seed=0,
+                 max_prefill_buckets=None):
+        model.eval()
+        self.model = model
+        self.cfg = model.config
+        self.B = int(max_batch_size)
+        self.S = int(max_seq_len)
+        if max_prefill_buckets is None:
+            # default: room for EVERY bucket this max_seq_len can produce
+            # (16, 32, ..., >=S) — a flat cap smaller than the bucket count
+            # would thrash full prefill recompiles on a spread-out prompt
+            # mix; pass an explicit cap to bound compiled-program memory
+            max_prefill_buckets = 1
+            while 16 << (max_prefill_buckets - 1) < self.S:
+                max_prefill_buckets += 1
+        self.params = {k: p._value for k, p in model.named_parameters()}
+        self.buffers = {k: b._value for k, b in model.named_buffers()}
+        self.finished: list[GenerationRequest] = []
+        self._key = jax.random.PRNGKey(seed)
+        self._req_seq = 0  # arrival index, keys each request's sample stream
+        self._prefill_cache = BoundedCompileCache(max_prefill_buckets,
+                                                  self.engine_label)
+        self._decode_jit = None
+        m = serving_metrics()
+        for name in ("tokens", "requests", "truncations"):
+            m[name].inc(0, engine=self.engine_label)  # series exists from t0
+
+    def _make_request(self, prompt_ids, **kw):
+        """Construct a request with its own sampling key, folded from the
+        engine seed and the ARRIVAL index: sampled output is a function of
+        (seed, arrival order, logits) only — invariant to slot assignment,
+        batch composition and preemption/resume timing, so the paged and
+        dense engines produce identical tokens for the same workload."""
+        req = GenerationRequest(prompt_ids, **kw)
+        req._sample_key = jax.random.fold_in(self._key, self._req_seq)
+        self._req_seq += 1
+        return req
+
+    # -- shared forward plumbing ---------------------------------------- #
+
+    def _functional_forward(self, p, b, tok, pos, caches, off, tables=None):
+        from ..jit import functional_call
+
+        c = [(Tensor(k_), Tensor(v_)) for k_, v_ in caches]
+        kwargs = {}
+        if tables is not None:
+            kwargs["block_tables"] = Tensor(tables)
+        (logits, new_c), _ = functional_call(
+            self.model, p, b, [Tensor(tok), Tensor(pos), c, Tensor(off)],
+            kwargs=kwargs, train=False)
+        return logits, new_c
+
+    def _run_prefill(self, req):
+        """Batch-1 prefill over a zeroed bucket-length dense cache. Returns
+        (logits [1, Sp, V] device, new_caches per layer [1, Sp, Hkv, D],
+        n, Sp)."""
+        n = len(req.prompt)
+        Sp = _bucket(n)
+
+        def compile_prefill():
+            def prefill(p, b, tok, pos, caches):
+                logits, new_c = self._functional_forward(
+                    p, b, tok, pos, caches, jnp.int32(0))
+                return logits, new_c
+
+            return jax.jit(prefill)
+
+        pf = self._prefill_cache.get_or_compile(Sp, compile_prefill)
+        tok = np.zeros((1, Sp), np.int32)
+        tok[0, :n] = req.prompt
+        pos = np.arange(Sp, dtype=np.int32)[None]
+        cfg = self.cfg
+        zero_c = [(jnp.zeros((1, Sp, cfg.kv_heads, cfg.head_dim),
+                             jnp.float32),) * 2
+                  for _ in range(cfg.num_layers)]
+        logits, new_c = pf(self.params, self.buffers,
+                           jnp.asarray(tok), jnp.asarray(pos), zero_c)
+        return logits, new_c, n, Sp
+
+    # -- sampling -------------------------------------------------------- #
+
+    def _pick_token(self, logits_row, req):
+        """logits_row may be a DEVICE array: greedy argmax and categorical
+        sampling both run on device and only the chosen token id crosses to
+        host — never the [vocab] row, and never the whole [B, vocab] batch
+        (one sampled request used to force that transfer for everyone)."""
+        if req.temperature == 0.0:
+            return int(jnp.argmax(jnp.asarray(logits_row)))
+        req._sample_key, sub = jax.random.split(req._sample_key)
+        return int(jax.random.categorical(
+            sub, jnp.asarray(logits_row) / req.temperature))
+
+    # -- SLO bookkeeping ------------------------------------------------- #
+
+    def _note_token(self, req, tok):
+        m = serving_metrics()
+        m["tokens"].inc(engine=self.engine_label)
+        if req._t_first is None:
+            req._t_first = time.perf_counter()
+            m["ttft"].observe(req._t_first - req._t_arrival,
+                              engine=self.engine_label)
+
+    def _retire_decision(self, req, tok, row_len):
+        """(done, truncated) after appending `tok` with `row_len` tokens
+        already in the cache. Capacity retirement that cut the request short
+        is surfaced as truncation instead of silently ending it."""
+        hit_eos = (req.eos_token_id is not None
+                   and int(tok) == req.eos_token_id)
+        budget_done = len(req.generated) >= req.max_new_tokens
+        cap_hit = row_len + 1 >= self.S
+        done = hit_eos or budget_done or cap_hit
+        truncated = cap_hit and not hit_eos and not budget_done
+        return done, truncated
+
+    def _note_finished(self, req, truncated):
+        req.done = True
+        m = serving_metrics()
+        m["requests"].inc(engine=self.engine_label)
+        if truncated:
+            req.truncated = True
+            m["truncations"].inc(engine=self.engine_label)
+        if req._t_first is not None and len(req.generated) > 1:
+            dt = time.perf_counter() - req._t_first
+            if dt > 0:
+                m["request_tps"].observe(len(req.generated) / dt,
+                                         engine=self.engine_label)
+        self.finished.append(req)
+
+    def run(self):
+        """Drain: step until every queued/live request finishes; returns
+        the finished requests in completion order."""
+        while self.has_work():
+            self.step()
+        done, self.finished = self.finished, []
+        return done
+
+    # subclass contract
+    def has_work(self) -> bool:
+        raise NotImplementedError
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+
+class ContinuousBatchingEngine(_ServingEngineBase):
+    """Admit-while-decoding scheduler over a slotted DENSE KV cache.
 
     add_request() enqueues; step() admits waiting requests into free slots
     (prefill) and advances every live slot by one token (single fixed-shape
     decode). run() drains everything and returns finished requests.
     """
 
-    def __init__(self, model, max_batch_size=8, max_seq_len=512, seed=0):
-        model.eval()
-        self.model = model
-        self.cfg = model.config
-        self.B = int(max_batch_size)
-        self.S = int(max_seq_len)
-        self.params = {k: p._value for k, p in model.named_parameters()}
-        self.buffers = {k: b._value for k, b in model.named_buffers()}
+    engine_label = "dense"
+
+    def __init__(self, model, max_batch_size=8, max_seq_len=512, seed=0,
+                 max_prefill_buckets=None):
+        super().__init__(model, max_batch_size, max_seq_len, seed,
+                         max_prefill_buckets)
         cfg = self.cfg
         self.caches = [
             (jnp.zeros((self.B, self.S, cfg.kv_heads, cfg.head_dim),
@@ -79,29 +249,19 @@ class ContinuousBatchingEngine:
         self.active: list[GenerationRequest | None] = [None] * self.B
         self.last_tok = np.zeros(self.B, np.int32)
         self.waiting: collections.deque = collections.deque()
-        self.finished: list[GenerationRequest] = []
-        self._key = jax.random.PRNGKey(seed)
-        self._prefill_cache = {}
-        self._decode_jit = None
 
     # ------------------------------------------------------------------ #
 
     def add_request(self, prompt_ids, **kw):
-        req = GenerationRequest(prompt_ids, **kw)
+        req = self._make_request(prompt_ids, **kw)
         if len(req.prompt) >= self.S:
             raise ValueError(
                 f"prompt length {len(req.prompt)} >= max_seq_len {self.S}")
         self.waiting.append(req)
         return req.req_id
 
-    def _functional_forward(self, p, b, tok, pos, caches, off):
-        from ..jit import functional_call
-
-        c = [(Tensor(k_), Tensor(v_)) for k_, v_ in caches]
-        (logits, new_c), _ = functional_call(
-            self.model, p, b, [Tensor(tok), Tensor(pos), c, Tensor(off)],
-            train=False)
-        return logits, new_c
+    def has_work(self):
+        return bool(self.waiting) or any(r is not None for r in self.active)
 
     # ------------------------------------------------------------------ #
 
@@ -110,56 +270,27 @@ class ContinuousBatchingEngine:
         while free and self.waiting:
             slot = free.pop(0)
             req = self.waiting.popleft()
-            n = len(req.prompt)
-            Sp = _bucket(n)
-            pf = self._prefill_cache.get(Sp)
-            if pf is None:
-                def prefill(p, b, tok, pos, caches):
-                    # batch-1 prefill with a fresh (zero) cache view
-                    logits, new_c = self._functional_forward(
-                        p, b, tok, pos, caches, jnp.int32(0))
-                    return logits, new_c
-
-                pf = jax.jit(prefill)
-                self._prefill_cache[Sp] = pf
-            tok = np.zeros((1, Sp), np.int32)
-            tok[0, :n] = req.prompt
-            pos = np.arange(Sp, dtype=np.int32)[None]
-            cfg = self.cfg
-            zero_c = [(jnp.zeros((1, Sp, cfg.kv_heads, cfg.head_dim),
-                                 jnp.float32),) * 2
-                      for _ in range(cfg.num_layers)]
-            logits, new_c = pf(self.params, self.buffers,
-                               jnp.asarray(tok), jnp.asarray(pos), zero_c)
+            logits, new_c, n, _ = self._run_prefill(req)
             # scatter the prompt's kv into this slot's cache rows [0, n)
             for li, (k_, v_) in enumerate(new_c):
                 bk, bv = self.caches[li]
                 bk = bk.at[slot, :n].set(k_[0, :n])
                 bv = bv.at[slot, :n].set(v_[0, :n])
                 self.caches[li] = (bk, bv)
-            first = self._pick_token(
-                np.asarray(logits)[0, n - 1], req)
+            # device row gather: only [vocab] of THIS row ever materializes
+            first = self._pick_token(logits[0, n - 1], req)
             self.active[slot] = req
             self.lengths[slot] = n
             self.last_tok[slot] = first
             self._emit(slot, first)
 
-    def _pick_token(self, logits_row, req):
-        if req.temperature == 0.0:
-            return int(np.argmax(logits_row))
-        self._key, sub = jax.random.split(self._key)
-        return int(jax.random.categorical(
-            sub, jnp.asarray(logits_row) / req.temperature))
-
     def _emit(self, slot, tok):
         req = self.active[slot]
         req.generated.append(int(tok))
-        hit_eos = (req.eos_token_id is not None
-                   and int(tok) == req.eos_token_id)
-        if (hit_eos or len(req.generated) >= req.max_new_tokens
-                or self.lengths[slot] + 1 >= self.S):
-            req.done = True
-            self.finished.append(req)
+        self._note_token(req, tok)
+        done, truncated = self._retire_decision(req, tok, self.lengths[slot])
+        if done:
+            self._note_finished(req, truncated)
             self.active[slot] = None
             self.lengths[slot] = 0
 
@@ -167,9 +298,17 @@ class ContinuousBatchingEngine:
 
     def step(self):
         """One scheduler tick: admit then decode-advance all live slots.
-        Returns {req_id: new_token} for tokens produced this tick."""
+        Returns {req_id: new_token} for the decode advance only — each
+        request's FIRST token is emitted at admission (onto req.generated
+        and serving_tokens_total), not in this dict."""
+        t_tick = time.perf_counter()
         self._admit()
+        m = serving_metrics()
         live = [i for i in range(self.B) if self.active[i] is not None]
+        m["queue_depth"].set(len(self.waiting),
+                             engine=self.engine_label, queue="prefill")
+        m["queue_depth"].set(len(live),
+                             engine=self.engine_label, queue="decode")
         if not live:
             return {}
         if self._decode_jit is None:
@@ -190,26 +329,20 @@ class ContinuousBatchingEngine:
         greedy_tok, logits, self.caches = self._decode_jit(
             self.params, self.buffers, jnp.asarray(self.last_tok), offs,
             self.caches)
-        need_logits = any(self.active[i].temperature != 0.0 for i in live)
         greedy_np = np.asarray(greedy_tok)
-        logits_np = np.asarray(logits) if need_logits else None
         out = {}
         for i in live:
             req = self.active[i]
             if req.temperature == 0.0:
                 tok = int(greedy_np[i])
             else:
-                tok = self._pick_token(logits_np[i], req)
+                # per-row device gather + on-device categorical: only the
+                # sampled token id is transferred, not [B, vocab]
+                tok = self._pick_token(logits[i], req)
             self.lengths[i] += 1
             self.last_tok[i] = tok
             out[req.req_id] = tok
             self._emit(i, tok)
+        m["step_seconds"].observe(time.perf_counter() - t_tick,
+                                  engine=self.engine_label)
         return out
-
-    def run(self):
-        """Drain: step until every queued/live request finishes; returns
-        the finished requests in completion order."""
-        while self.waiting or any(r is not None for r in self.active):
-            self.step()
-        done, self.finished = self.finished, []
-        return done

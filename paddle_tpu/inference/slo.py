@@ -1,0 +1,132 @@
+"""Serving SLO instrumentation shared by both generation engines.
+
+Two pieces, both engine-agnostic (an `engine` label distinguishes the dense
+`ContinuousBatchingEngine` from the paged `PagedServingEngine`):
+
+- `serving_metrics()` — the serving metric families, declared through the
+  PR-3 observability registry via a `HandleCache` so handles survive
+  `reset_default_registry()` (tests) without re-taking the declaration lock
+  on the hot scheduler path. The catalog lives in docs/OBSERVABILITY.md and
+  docs/SERVING.md.
+- `BoundedCompileCache` — the per-bucket prefill program cache. Prompts pad
+  to power-of-two length buckets so compile count is bounded *per mix*, but
+  a pathological prompt-length distribution could still grow one compiled
+  program per bucket forever; the cache caps live buckets (oldest-inserted
+  evicted — deliberately FIFO, not LRU: an evicted bucket that comes back
+  recompiles and the counter shows it) and emits
+  `serving_prefill_compiles_total{engine=,bucket=}` on every real compile so
+  that growth is visible in the metrics, never silent.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from ..observability.metrics import DEFAULT_BUCKETS, HandleCache
+
+__all__ = ["serving_metrics", "BoundedCompileCache"]
+
+# tokens/s per finished request: 0.5 .. 4096, x2 per bucket
+_TPS_BUCKETS = tuple(0.5 * 2 ** i for i in range(14))
+
+
+def _build(reg):
+    return {
+        "ttft": reg.histogram(
+            "serving_ttft_seconds",
+            "Time from add_request to the request's first generated token",
+            labelnames=("engine",)),
+        "request_tps": reg.histogram(
+            "serving_request_tokens_per_second",
+            "Per finished request: generated tokens / (finish - first token)",
+            labelnames=("engine",), buckets=_TPS_BUCKETS),
+        "step_seconds": reg.histogram(
+            "serving_step_seconds",
+            "Wall time of one scheduler tick (admit + decode advance)",
+            labelnames=("engine",), buckets=DEFAULT_BUCKETS),
+        "tokens": reg.counter(
+            "serving_tokens_total", "Generated tokens", ("engine",)),
+        "requests": reg.counter(
+            "serving_requests_total", "Finished requests", ("engine",)),
+        "truncations": reg.counter(
+            "serving_truncations_total",
+            "Requests retired by KV-cache capacity before max_new_tokens/EOS",
+            ("engine",)),
+        "queue_depth": reg.gauge(
+            "serving_queue_depth",
+            "Requests waiting (queue=prefill|resume) or live (queue=decode)",
+            ("engine", "queue")),
+        "pages_free": reg.gauge(
+            "serving_pages_free", "Free physical KV pages in the block pool"),
+        "pages_total": reg.gauge(
+            "serving_pages_total",
+            "Allocatable physical KV pages (excludes the reserved null page)"),
+        "prefix_lookups": reg.counter(
+            "serving_prefix_lookups_total",
+            "Prompt-page hash lookups against the shared-prefix map"),
+        "prefix_hits": reg.counter(
+            "serving_prefix_hits_total",
+            "Prompt pages served by an existing shared page (no new page)"),
+        "cow_copies": reg.counter(
+            "serving_cow_copies_total",
+            "Copy-on-write page copies on first divergent write"),
+        "preemptions": reg.counter(
+            "serving_preemptions_total",
+            "Requests evicted to the host spill buffer when the pool ran dry"),
+        "preempted_pages": reg.counter(
+            "serving_preempted_pages_total",
+            "Pages released by preemption"),
+        "resumes": reg.counter(
+            "serving_resumes_total",
+            "Spilled requests re-admitted from the host buffer"),
+        "prefill_compiles": reg.counter(
+            "serving_prefill_compiles_total",
+            "Prefill program compiles, one per live length bucket",
+            ("engine", "bucket")),
+    }
+
+
+_HANDLES = HandleCache(_build)
+
+
+def serving_metrics() -> dict:
+    """Current-registry serving metric handles (rebuilt after registry
+    resets; a two-attribute read steady-state)."""
+    return _HANDLES.get()
+
+
+class BoundedCompileCache:
+    """{bucket -> compiled program} with an explicit max and FIFO eviction.
+
+    get_or_compile() counts every real compile in
+    serving_prefill_compiles_total{engine=,bucket=} — including recompiles of
+    a previously evicted bucket, which is exactly the signal that the cap is
+    too small for the traffic's prompt-length mix.
+    """
+
+    def __init__(self, max_entries: int, engine: str):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = int(max_entries)
+        self.engine = engine
+        self.compiles_total = 0  # lifetime compiles (bench warmup detection)
+        self._programs: collections.OrderedDict = collections.OrderedDict()
+
+    def __len__(self):
+        return len(self._programs)
+
+    def __contains__(self, bucket):
+        return bucket in self._programs
+
+    def get_or_compile(self, bucket, compile_fn):
+        prog = self._programs.get(bucket)
+        if prog is not None:
+            return prog
+        prog = compile_fn()
+        self.compiles_total += 1
+        serving_metrics()["prefill_compiles"].inc(
+            engine=self.engine, bucket=str(bucket))
+        self._programs[bucket] = prog
+        while len(self._programs) > self.max_entries:
+            self._programs.popitem(last=False)  # oldest bucket out
+        return prog

@@ -162,7 +162,7 @@ class GPTAttention(nn.Layer):
                                           has_bias=bias, input_is_parallel=True)
 
     def forward(self, x, position_ids=None, cache=None, cache_offset=None,
-                startend_row_indices=None):
+                startend_row_indices=None, block_tables=None):
         cfg = self.config
         B, S = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([B, S, cfg.num_heads, cfg.head_dim])
@@ -182,7 +182,22 @@ class GPTAttention(nn.Layer):
                 rotary_emb_base=cfg.rope_theta,
             )
         new_cache = None
-        if cache is not None:
+        if cache is not None and block_tables is not None:
+            # paged KV cache: cache.k/v are [n_pages, Hkv, page_size, D];
+            # block_tables [B, P] maps each row's logical pages to physical
+            # ones. Single-token decode only — the step's K/V rows scatter
+            # into each row's next slot, then the Pallas paged kernel streams
+            # exactly the live pages (scalar-prefetched block table resolves
+            # the physical index in the BlockSpec index_map; no gathered
+            # cache copy is ever materialized).
+            k_all = run_op("paged_kv_update", _paged_update,
+                           [cache[0], k, block_tables, cache_offset])
+            v_all = run_op("paged_kv_update", _paged_update,
+                           [cache[1], v, block_tables, cache_offset])
+            new_cache = (k_all, v_all)
+            out = run_op("paged_decode_attention", _paged_attend,
+                         [q, k_all, v_all, block_tables, cache_offset])
+        elif cache is not None:
             # static-capacity KV cache: cache.k/v are [B, S_max, Hkv, D]
             k_all = run_op("kv_cache_update", _dyn_update, [cache[0], k, cache_offset])
             v_all = run_op("kv_cache_update", _dyn_update, [cache[1], v, cache_offset])
@@ -238,6 +253,28 @@ def _dyn_update(buf, new, off):
             buf, new.astype(buf.dtype), (0, off.reshape(()), 0, 0))
     B = buf.shape[0]
     return buf.at[jnp.arange(B), off].set(new[:, 0].astype(buf.dtype))
+
+
+def _paged_update(buf, new, tables, lengths):
+    """Write this step's `new` [B, 1, H, D] K/V rows into the paged cache
+    `buf` [n_pages, Hkv, ps, D] at each row's next slot (decode is S==1)."""
+    from ..ops.pallas.decode_attention import paged_kv_write
+
+    return paged_kv_write(buf, new[:, 0], tables,
+                          jnp.asarray(lengths).astype(jnp.int32))
+
+
+def _paged_attend(q, kc, vc, tables, lengths):
+    """q [B, 1, H, D] (one decode step) against the paged cache; `lengths`
+    counts tokens present BEFORE this step, and the step's K/V were just
+    written by _paged_update, so the kernel sees lengths + 1 valid tokens."""
+    from ..ops.pallas.decode_attention import paged_decode_attention
+
+    B, S, H, D = q.shape
+    o = paged_decode_attention(
+        q.reshape(B, H, D), kc, vc, tables,
+        jnp.asarray(lengths).astype(jnp.int32) + 1)
+    return o.reshape(B, S, H, D)
 
 
 def _decode_mask(s_max, offset, s_new):
@@ -300,11 +337,12 @@ class GPTDecoderLayer(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
     def forward(self, x, position_ids=None, cache=None, cache_offset=None,
-                startend_row_indices=None):
+                startend_row_indices=None, block_tables=None):
         residual = x
         h = self.input_layernorm(x)
         if cache is not None:
-            h, new_cache = self.self_attn(h, position_ids, cache, cache_offset)
+            h, new_cache = self.self_attn(h, position_ids, cache, cache_offset,
+                                          block_tables=block_tables)
         else:
             h = self.self_attn(
                 h, position_ids, startend_row_indices=startend_row_indices)
@@ -339,7 +377,8 @@ class GPTModel(nn.Layer):
         self.final_norm = _make_norm(config)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                cache_offset=None, attn_startend_row_indices=None):
+                cache_offset=None, attn_startend_row_indices=None,
+                block_tables=None):
         B, S = input_ids.shape[0], input_ids.shape[1]
         if position_ids is None:
             if caches is not None and cache_offset is not None:
@@ -373,7 +412,8 @@ class GPTModel(nn.Layer):
 
         def run_layer(layer, h, cache):
             if cache is not None:
-                return layer(h, position_ids, cache, cache_offset)
+                return layer(h, position_ids, cache, cache_offset,
+                             block_tables=block_tables)
             return layer(h, position_ids,
                          startend_row_indices=attn_startend_row_indices)
 
@@ -414,9 +454,11 @@ class GPTForCausalLM(nn.Layer):
             )
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                cache_offset=None, attn_startend_row_indices=None):
+                cache_offset=None, attn_startend_row_indices=None,
+                block_tables=None):
         out = self.gpt(input_ids, position_ids, caches, cache_offset,
-                       attn_startend_row_indices=attn_startend_row_indices)
+                       attn_startend_row_indices=attn_startend_row_indices,
+                       block_tables=block_tables)
         if caches is not None:
             h, new_caches = out
         else:

@@ -31,7 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 from . import interpret_mode
 from .flash_attention import NEG_INF
 
-__all__ = ["paged_decode_attention", "dense_decode_attention"]
+__all__ = ["paged_decode_attention", "dense_decode_attention",
+           "paged_kv_write"]
 
 
 def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
@@ -157,6 +158,25 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables, lengths,
     out = _run_decode(q4, key_cache, value_cache, block_tables, lengths,
                       scale, paged=True)
     return out.reshape(B, H, D)
+
+
+def paged_kv_write(cache, new, block_tables, lengths):
+    """Scatter one decode step's K (or V) rows into the paged cache.
+
+    cache: [n_pages, Hkv, page_size, D]; new: [B, Hkv, D] (this step's
+    projection per row); block_tables: [B, P] physical page ids (-1 unused);
+    lengths: [B] tokens already present per row — the write lands at logical
+    slot `lengths[b]`, i.e. physical (tables[b, lengths[b]//ps],
+    lengths[b]%ps). Rows whose target table entry is -1 (parked/batch-pad
+    rows) are routed to physical page 0, the pool's reserved null page, which
+    no live block table ever references. Pure/jittable; owns the page layout
+    so callers never index the cache themselves."""
+    B = new.shape[0]
+    ps = cache.shape[2]
+    lengths = lengths.astype(jnp.int32)
+    page = block_tables[jnp.arange(B), lengths // ps]
+    page = jnp.where(page < 0, 0, page)
+    return cache.at[page, :, lengths % ps].set(new.astype(cache.dtype))
 
 
 def dense_decode_attention(q, key_cache, value_cache, lengths, scale=None):

@@ -1,0 +1,168 @@
+"""Direct correctness coverage for ops/pallas/decode_attention.py — the
+paged/dense decode kernels vs a numpy oracle under interpret mode (the
+serving engines exercise them end-to-end; these pin the kernel contract
+itself: GQA head groups, partially-filled final pages, -1 unused
+block-table entries, and the `l == 0` zero-length-row guard in _finish)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.decode_attention import (
+    dense_decode_attention,
+    paged_decode_attention,
+    paged_kv_write,
+)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(pallas_interpret_unless_hw):
+    pass
+
+
+def _ref_attend(q_bh, keys, vals, L, scale):
+    """One (row, head): softmax(q·K[:L]) @ V[:L] in f64-ish numpy."""
+    if L == 0:
+        return np.zeros_like(q_bh)
+    s = keys[:L] @ q_bh * scale
+    p = np.exp(s - s.max())
+    p /= p.sum()
+    return p @ vals[:L]
+
+
+def _ref_paged(q, kc, vc, tables, lengths):
+    B, H, D = q.shape
+    _, Hkv, ps, _ = kc.shape
+    P = tables.shape[1]
+    S = P * ps
+    g = H // Hkv
+    kc, vc = np.asarray(kc), np.asarray(vc)
+    out = np.zeros((B, H, D), np.float32)
+    for b in range(B):
+        keys = np.zeros((S, Hkv, D), np.float32)
+        vals = np.zeros_like(keys)
+        for j in range(P):
+            t = int(tables[b, j])
+            if t >= 0:
+                keys[j * ps:(j + 1) * ps] = kc[t].transpose(1, 0, 2)
+                vals[j * ps:(j + 1) * ps] = vc[t].transpose(1, 0, 2)
+        for h in range(H):
+            out[b, h] = _ref_attend(np.asarray(q)[b, h], keys[:, h // g],
+                                    vals[:, h // g], int(lengths[b]),
+                                    D ** -0.5)
+    return out
+
+
+def _make_case(B, H, Hkv, D, ps, P, lengths, seed=0, n_pages=None):
+    """Random paged cache + per-row block tables covering `lengths` tokens;
+    entries past each row's last page are -1."""
+    rng = np.random.default_rng(seed)
+    need = [-(-L // ps) if L else 0 for L in lengths]
+    if n_pages is None:
+        n_pages = 1 + sum(need)  # page 0 = null
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((n_pages, Hkv, ps, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((n_pages, Hkv, ps, D)), jnp.float32)
+    tables = np.full((B, P), -1, np.int32)
+    nxt = 1
+    for b, m in enumerate(need):
+        for j in range(m):
+            tables[b, j] = nxt
+            nxt += 1
+    return q, kc, vc, jnp.asarray(tables), jnp.asarray(
+        np.asarray(lengths, np.int32))
+
+
+CASES = [
+    # B, H, Hkv, D, ps, P, lengths
+    (2, 4, 4, 32, 16, 4, [64, 32]),          # MHA, full pages
+    (2, 4, 2, 32, 16, 4, [48, 16]),          # GQA head groups
+    (3, 4, 1, 16, 8, 8, [13, 27, 5]),        # MQA, partial final pages
+    (2, 2, 2, 16, 16, 2, [17, 31]),          # partial fill + -1 tail entries
+]
+
+
+@pytest.mark.parametrize("B,H,Hkv,D,ps,P,lengths", CASES)
+def test_paged_decode_matches_reference(B, H, Hkv, D, ps, P, lengths):
+    q, kc, vc, tables, lens = _make_case(B, H, Hkv, D, ps, P, lengths)
+    out = paged_decode_attention(q, kc, vc, tables, lens)
+    ref = _ref_paged(q, kc, vc, np.asarray(tables), np.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=2e-5)
+
+
+def test_zero_length_row_outputs_zeros():
+    """The `l == 0` guard in _decode_kernel._finish: a row with no valid
+    tokens (every page skipped) must return zeros, not NaN from 0/0."""
+    q, kc, vc, tables, lens = _make_case(3, 4, 2, 16, 8, 4, [16, 0, 9])
+    out = np.asarray(paged_decode_attention(q, kc, vc, tables, lens))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+    ref = _ref_paged(q, kc, vc, np.asarray(tables), np.asarray(lens))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
+
+
+def test_unused_table_entries_are_skipped():
+    """-1 entries (and whatever stale page ids would sit behind them) must
+    not contribute: truncating a row's table to -1 changes nothing vs a
+    shorter reference, even though the physical pages still hold data."""
+    q, kc, vc, tables, lens = _make_case(1, 2, 2, 16, 8, 4, [16])
+    tables = np.asarray(tables).copy()
+    # leave garbage pages allocated beyond the valid range; table says -1
+    out = paged_decode_attention(q, kc, vc, jnp.asarray(tables), lens)
+    ref = _ref_paged(q, kc, vc, tables, np.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=2e-5)
+
+
+def test_dense_decode_matches_reference():
+    rng = np.random.default_rng(3)
+    B, H, Hkv, D, S = 2, 4, 2, 32, 64
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    lens = np.asarray([37, 64], np.int32)
+    out = dense_decode_attention(q, kc, vc, jnp.asarray(lens))
+    g = H // Hkv
+    ref = np.zeros((B, H, D), np.float32)
+    for b in range(B):
+        for h in range(H):
+            ref[b, h] = _ref_attend(
+                np.asarray(q)[b, h],
+                np.asarray(kc)[b, h // g], np.asarray(vc)[b, h // g],
+                int(lens[b]), D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=2e-5)
+
+
+class TestPagedKvWrite:
+    def test_write_lands_at_next_slot(self):
+        B, Hkv, D, ps, P, n_pages = 2, 2, 8, 4, 4, 6
+        kc = jnp.zeros((n_pages, Hkv, ps, D), jnp.float32)
+        tables = np.full((B, P), -1, np.int32)
+        tables[0, :2] = [1, 2]
+        tables[1, :1] = [3]
+        lengths = np.asarray([5, 2], np.int32)  # slots (page 2, 1), (page 3, 2)
+        new = jnp.asarray(
+            np.arange(B * Hkv * D, dtype=np.float32).reshape(B, Hkv, D) + 1.0)
+        out = np.array(paged_kv_write(kc, new, jnp.asarray(tables),
+                                      jnp.asarray(lengths)))
+        np.testing.assert_array_equal(out[2, :, 1], np.asarray(new)[0])
+        np.testing.assert_array_equal(out[3, :, 2], np.asarray(new)[1])
+        # nothing else touched
+        out[2, :, 1] = 0
+        out[3, :, 2] = 0
+        assert not out.any()
+
+    def test_parked_rows_hit_null_page(self):
+        """Rows whose table entry is -1 (inactive program rows) write page 0
+        — the reserved null page — and corrupt nothing allocatable."""
+        B, Hkv, D, ps, P, n_pages = 2, 1, 4, 4, 2, 4
+        kc = jnp.zeros((n_pages, Hkv, ps, D), jnp.float32)
+        tables = np.full((B, P), -1, np.int32)
+        tables[0, 0] = 1
+        lengths = np.asarray([1, 0], np.int32)
+        new = jnp.ones((B, Hkv, D), jnp.float32)
+        out = np.asarray(paged_kv_write(kc, new, jnp.asarray(tables),
+                                        jnp.asarray(lengths)))
+        assert out[1, :, 1].any()          # live row wrote its slot
+        assert out[0, :, 0].any()          # parked row landed on null page
+        assert not out[2:].any()           # no allocatable page touched

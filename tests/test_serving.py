@@ -83,6 +83,76 @@ class TestContinuousBatching:
         assert {r.req_id for r in done} == {a, b}
 
 
+class TestServingSatellites:
+    def test_sampled_rows_leave_greedy_rows_untouched(self, model):
+        """One sampled-temperature request must not perturb the greedy
+        requests batched with it (the old path materialized the whole
+        [B, vocab] logits on host for everyone; now each sampled row
+        gathers only its own slice, and greedy stays on device)."""
+        prompts = [np.array([5, 7, 11], np.int32),
+                   np.array([2, 3], np.int32)]
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       max_seq_len=64, seed=0)
+        g_only = eng.add_request(prompts[0], max_new_tokens=4,
+                                 temperature=0.0)
+        ref = {r.req_id: r.generated for r in eng.run()}[g_only]
+
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       max_seq_len=64, seed=0)
+        g = eng.add_request(prompts[0], max_new_tokens=4, temperature=0.0)
+        eng.add_request(prompts[1], max_new_tokens=4, temperature=0.9)
+        out = {r.req_id: r.generated for r in eng.run()}
+        assert out[g] == ref
+
+    def test_sampled_stream_deterministic_per_seed_and_arrival(self, model):
+        """Per-request sampling keys fold (engine seed, arrival index):
+        the same workload on the same seed reproduces exactly."""
+        prompt = np.array([9, 8, 7], np.int32)
+
+        def run_once():
+            eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                           max_seq_len=64, seed=5)
+            eng.add_request(prompt, max_new_tokens=5, temperature=0.8)
+            return eng.run()[0].generated
+
+        assert run_once() == run_once()
+
+    def test_truncated_flag_on_capacity_retirement(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       max_seq_len=16)
+        eng.add_request(np.arange(1, 11, dtype=np.int32),
+                        max_new_tokens=100)
+        done = eng.run()
+        assert done[0].truncated and len(done[0].generated) == 6
+        # a request that finishes inside its budget is NOT flagged
+        eng.add_request(np.array([1, 2, 3], np.int32), max_new_tokens=2)
+        assert not eng.run()[0].truncated
+
+    def test_prefill_compile_cache_capped(self, model):
+        """Live prefill buckets are capped (oldest evicted) and every real
+        compile — including a re-compile after eviction — lands in
+        serving_prefill_compiles_total{engine=,bucket=}."""
+        from paddle_tpu.observability.metrics import default_registry
+
+        def compiles(bucket):
+            m = default_registry().get("serving_prefill_compiles_total")
+            return m.value(engine="dense", bucket=bucket) if m else 0.0
+
+        c16 = compiles("16")
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       max_seq_len=128,
+                                       max_prefill_buckets=2)
+        for n in (5, 20, 40):  # buckets 16, 32, 64 -> 16 evicted
+            eng.add_request(np.arange(1, n + 1, dtype=np.int32),
+                            max_new_tokens=1)
+            eng.run()
+        assert len(eng._prefill_cache) == 2
+        assert 16 not in eng._prefill_cache and 64 in eng._prefill_cache
+        eng.add_request(np.arange(1, 6, dtype=np.int32), max_new_tokens=1)
+        eng.run()
+        assert compiles("16") == c16 + 2  # eviction made the recompile visible
+
+
 class TestQuantizedServing:
     def test_weight_only_generation_and_serving(self):
         """quantize_for_inference converts Linear (incl. degenerate
