@@ -142,7 +142,29 @@ def _timed_steps(step_fn, steps, trace_dir=None, warmup=3, rung=None):
             "overlap_fraction": round(agg["fraction"], 4),
             "comm_exposed_s_per_step": round(agg["exposed_s"] / n, 6),
         }
+        info.update(_kernel_ladder_info())
     return dt, info
+
+
+def _kernel_ladder_info():
+    """Pallas-kernel attribution for the perf line (under --emit-metrics):
+    which fused kernels were live (toggle x backend) and the autotuned tile
+    + hit/miss/fallback counts per kernel — so a BENCH round's MFU movement
+    can be attributed to tile choices, not guessed at."""
+    try:
+        from paddle_tpu.nn.functional.flash_attention import _use_pallas_kernel
+        from paddle_tpu.ops.pallas import autotune as _autotune
+        from paddle_tpu.ops.pallas.fused_norm import fused_norm_on
+        from paddle_tpu.ops.pallas.fused_rope import fused_rope_on
+
+        pallas = _use_pallas_kernel()
+        return {
+            "fused_norm": bool(pallas and fused_norm_on()),
+            "fused_rope": bool(pallas and fused_rope_on()),
+            "autotuned_tiles": _autotune.chosen_tiles(),
+        }
+    except Exception:
+        return {}
 
 
 def _emit(name, dt, flops, tokens=None, extra=None):

@@ -34,6 +34,23 @@ def _pallas_decode_on():
 
     return _use_pallas_kernel()
 
+
+def _fused_norm_route():
+    """Trace-time capture of the PADDLE_TPU_FUSED_NORM toggle + Pallas
+    availability (see nn/functional/norm.py _fused_norm_route)."""
+    from ....ops.pallas.fused_norm import fused_norm_on
+
+    return fused_norm_on() and _pallas_decode_on()
+
+
+def _fused_rope_route():
+    """Trace-time capture of the PADDLE_TPU_FUSED_ROPE toggle + Pallas
+    availability; captured into the traced closure so an env flip between
+    forward and backward tracing cannot mix kernel and composite paths."""
+    from ....ops.pallas.fused_rope import fused_rope_on
+
+    return fused_rope_on() and _pallas_decode_on()
+
 __all__ = [
     "swiglu",
     "fused_rotary_position_embedding",
@@ -126,6 +143,7 @@ def fused_rotary_position_embedding(
         ext.append(_t(position_ids))
     has_pos = position_ids is not None
     n_qkv = len(tensors)
+    use_fused = _fused_rope_route()
 
     def fn(*args):
         qkv = list(args[:n_qkv])
@@ -151,7 +169,16 @@ def fused_rotary_position_embedding(
         else:
             pid = rest[0] if has_pos else None
             c, s = _rope_tables(S, D, rotary_emb_base, qkv[0].dtype, pid)
-        outs = [_apply_rope_one(t, c, s, use_neox_rotary_style) for t in qkv]
+        if use_fused and D % 2 == 0:
+            # one Pallas pass over every given tensor (q, k, and v when the
+            # caller rotates it) — paddle_tpu.ops.pallas.fused_rope
+            from ....ops.pallas.fused_rope import apply_fused_rope
+
+            outs = list(apply_fused_rope(
+                tuple(qkv), c, s, interleaved=not use_neox_rotary_style))
+        else:
+            outs = [_apply_rope_one(t, c, s, use_neox_rotary_style)
+                    for t in qkv]
         if time_major:
             outs = [jnp.swapaxes(t, 0, 1) for t in outs]
         return tuple(outs) if len(outs) > 1 else outs[0]
@@ -179,7 +206,8 @@ def fused_rms_norm(
 ):
     """RMSNorm fused with optional residual-add + bias
     (reference: fused_rms_norm.py:59; fused_layernorm_kernel.cu residual path).
-    Returns (out, residual_out) like the reference."""
+    Returns (out, residual_out) like the reference. Last-axis norms route to
+    the fused Pallas kernel (PADDLE_TPU_FUSED_NORM toggle, default on)."""
     ins = [_t(x), _t(norm_weight)]
     has_nb = norm_bias is not None
     has_b = bias is not None
@@ -187,6 +215,7 @@ def fused_rms_norm(
     for extra, flag in ((norm_bias, has_nb), (bias, has_b), (residual, has_r)):
         if flag:
             ins.append(_t(extra))
+    fused = _fused_norm_route()
 
     def fn(a, w, *rest):
         i = 0
@@ -195,13 +224,20 @@ def fused_rms_norm(
         b = rest[i] if has_b else None
         i += has_b
         r = rest[i] if has_r else None
-        h = a.astype(jnp.float32)
-        if b is not None:
-            h = h + b.astype(jnp.float32)
-        if r is not None:
-            h = h + r.astype(jnp.float32)
-        res_out = h.astype(a.dtype)
-        axes = tuple(range(begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis, a.ndim))
+        ax = begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis
+        # ONE pre-add block feeding both paths, so the fused/composite A/B
+        # cannot diverge on bias/residual handling. With no pre-adds h
+        # stays in the input dtype — the fused kernel upcasts in-stream
+        # (no f32 copy); the composite upcasts below.
+        h, res_out = _norm_preadd(a, b, r)
+        if (fused and ax == a.ndim - 1 and a.ndim >= 2 and w.ndim == 1
+                and (nb is None or nb.ndim == 1)):
+            from ....ops.pallas.fused_norm import rms_norm_fwd
+
+            return (rms_norm_fwd(h, w, epsilon, bias=nb).astype(a.dtype),
+                    res_out)
+        h = h.astype(jnp.float32)
+        axes = tuple(range(ax, a.ndim))
         var = jnp.mean(jnp.square(h), axis=axes, keepdims=True)
         out = h * jax.lax.rsqrt(var + epsilon) * w.astype(jnp.float32)
         if nb is not None:
@@ -210,6 +246,21 @@ def fused_rms_norm(
 
     out, res_out = run_op("fused_rms_norm", fn, ins)
     return out, res_out
+
+
+def _norm_preadd(a, b, r, alpha=1.0):
+    """Shared fused_rms_norm / fused_layer_norm pre-norm adds: h = a (+ b)
+    (+ r * alpha) in f32, and the residual_out in a's dtype. With neither
+    b nor r, returns `a` itself untouched (the reference's res_out equals
+    the input exactly in that case)."""
+    if b is None and r is None:
+        return a, a
+    h = a.astype(jnp.float32)
+    if b is not None:
+        h = h + b.astype(jnp.float32)
+    if r is not None:
+        h = h + r.astype(jnp.float32) * alpha
+    return h, h.astype(a.dtype)
 
 
 def fused_layer_norm(
@@ -229,7 +280,8 @@ def fused_layer_norm(
 ):
     """LayerNorm fused with residual-add (+alpha) and bias
     (reference: fused_layer_norm.py; residual_alpha at
-    fused_layernorm_kernel.cu:1003). Returns (out, residual_out)."""
+    fused_layernorm_kernel.cu:1003). Returns (out, residual_out). Last-axis
+    norms route to the fused Pallas kernel (PADDLE_TPU_FUSED_NORM)."""
     ins = [_t(x), _t(norm_weight)]
     has_nb = norm_bias is not None
     has_b = bias is not None
@@ -237,6 +289,7 @@ def fused_layer_norm(
     for extra, flag in ((norm_bias, has_nb), (bias, has_b), (residual, has_r)):
         if flag:
             ins.append(_t(extra))
+    fused = _fused_norm_route()
 
     def fn(a, w, *rest):
         i = 0
@@ -245,13 +298,15 @@ def fused_layer_norm(
         b = rest[i] if has_b else None
         i += has_b
         r = rest[i] if has_r else None
-        h = a.astype(jnp.float32)
-        if b is not None:
-            h = h + b.astype(jnp.float32)
-        if r is not None:
-            h = h + r.astype(jnp.float32) * residual_alpha
-        res_out = h.astype(a.dtype)
         ax = begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis
+        h, res_out = _norm_preadd(a, b, r, alpha=residual_alpha)
+        if (fused and ax == a.ndim - 1 and a.ndim >= 2 and w.ndim == 1
+                and (nb is None or nb.ndim == 1)):
+            from ....ops.pallas.fused_norm import layer_norm_fwd
+
+            return (layer_norm_fwd(h, w, nb, epsilon).astype(a.dtype),
+                    res_out)
+        h = h.astype(jnp.float32)
         axes = tuple(range(ax, a.ndim))
         mean = jnp.mean(h, axis=axes, keepdims=True)
         var = jnp.mean(jnp.square(h - mean), axis=axes, keepdims=True)

@@ -29,6 +29,22 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _fused_norm_route() -> bool:
+    """True when last-axis norms should run the fused Pallas kernels
+    (paddle_tpu.ops.pallas.fused_norm). Read ONCE per call at the eager
+    entry and captured into the traced closure — the dispatch cache keys on
+    it, and under jit the choice is frozen at trace time, so a
+    PADDLE_TPU_FUSED_NORM flip mid-run can never mix the kernel forward
+    with the composite backward (the PR-7 safe-softmax capture rule)."""
+    from ...ops.pallas.fused_norm import fused_norm_on
+
+    if not fused_norm_on():
+        return False
+    from .flash_attention import _use_pallas_kernel
+
+    return _use_pallas_kernel()
+
+
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     def fn(a):
         nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
@@ -49,18 +65,28 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     if has_b:
         ins.append(_t(bias))
 
+    fused = n_axes == 1 and _fused_norm_route()
+
     def fn(a, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        i += has_w
+        b = rest[i] if has_b else None
+        if (fused and a.ndim >= 2
+                and (w is None or w.ndim == 1)
+                and (b is None or b.ndim == 1)):
+            from ...ops.pallas.fused_norm import layer_norm_fwd
+
+            return layer_norm_fwd(a, w, b, epsilon)
         axes = tuple(range(a.ndim - n_axes, a.ndim))
         x32 = a.astype(jnp.float32)
         mean = jnp.mean(x32, axis=axes, keepdims=True)
         var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
         out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
-        i = 0
-        if has_w:
-            out = out * rest[i].astype(jnp.float32)
-            i += 1
-        if has_b:
-            out = out + rest[i].astype(jnp.float32)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
         return out.astype(a.dtype)
 
     return run_op("layer_norm", fn, ins)
@@ -68,18 +94,26 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py:59).
-    Stats in f32 regardless of input dtype, like the reference kernel."""
+    Stats in f32 regardless of input dtype, like the reference kernel. On
+    TPU (and under the Pallas interpreter) this routes to the fused Pallas
+    kernel unless PADDLE_TPU_FUSED_NORM=0 selects the lax composite."""
     ins = [_t(x)]
     has_w = weight is not None
     if has_w:
         ins.append(_t(weight))
+    fused = _fused_norm_route()
 
     def fn(a, *rest):
+        w = rest[0] if rest else None
+        if fused and a.ndim >= 2 and (w is None or w.ndim == 1):
+            from ...ops.pallas.fused_norm import rms_norm_fwd
+
+            return rms_norm_fwd(a, w, epsilon)
         x32 = a.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         out = x32 * jax.lax.rsqrt(var + epsilon)
-        if rest:
-            out = out * rest[0].astype(jnp.float32)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
         return out.astype(a.dtype)
 
     return run_op("rms_norm", fn, ins)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -221,6 +222,17 @@ def _emit_overlap_metrics(ov):
         covered.inc(ov["covered_s"])
 
 
+def _autotune_snapshot():
+    """Chosen Pallas kernel tiles + per-kernel autotune hit/miss/fallback
+    counts, folded into each step record so BENCH rounds can attribute MFU
+    movement to tile choices. sys.modules lookup, never an import — a run
+    that launched no Pallas kernel pays nothing and records nothing."""
+    mod = sys.modules.get("paddle_tpu.ops.pallas.autotune")
+    if mod is None:
+        return None
+    return mod.chosen_tiles() or None
+
+
 # --------------------------------------------------------------------------- #
 # StepTimeline
 # --------------------------------------------------------------------------- #
@@ -369,6 +381,9 @@ class StepTimeline:
             "dispatch": {k: d1[k] - d0[k]
                          for k in ("hits", "misses", "bypass")},
         }
+        tiles = _autotune_snapshot()
+        if tiles:
+            record["autotune"] = tiles
         if extra:
             record.update(extra)
         _emit_overlap_metrics(overlap)

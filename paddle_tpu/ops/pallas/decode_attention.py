@@ -85,10 +85,20 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[0:g, :] / l_safe).astype(o_ref.dtype)
 
 
-def _run_decode(q, kc, vc, tables, lengths, scale, paged):
+def _default_dense_ps(s_max):
+    """Dense-cache sequence tile: largest power of two <= 256 dividing the
+    static cache capacity."""
+    ps = min(256, s_max)
+    while s_max % ps:
+        ps //= 2
+    return ps
+
+
+def _run_decode(q, kc, vc, tables, lengths, scale, paged, ps=None):
     """q: [B, Hkv, g, D]; kc/vc paged [n_pages, Hkv, ps, D] or dense
     [B, Hkv, S_max, D] (viewed as ps-sized pages). tables: [B, P] (paged) or
-    a dummy [B, 1] (dense)."""
+    a dummy [B, 1] (dense). For the dense layout `ps` selects the sequence
+    tile (autotunable); paged `ps` IS the cache's physical page size."""
     B, Hkv, g, D = q.shape
     if paged:
         _, _, ps, _ = kc.shape
@@ -99,9 +109,8 @@ def _run_decode(q, kc, vc, tables, lengths, scale, paged):
             return (jnp.where(t < 0, 0, t), h, 0, 0)
     else:
         S_max = kc.shape[2]
-        ps = min(256, S_max)
-        while S_max % ps:
-            ps //= 2
+        if ps is None:
+            ps = _default_dense_ps(S_max)
         P = S_max // ps
 
         def kmap(b, h, p, tabs, lens):
@@ -155,9 +164,28 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables, lengths,
     if scale is None:
         scale = D ** -0.5
     q4, g = _split_heads(q, Hkv)
+    _consult_tuner_paged(q4, key_cache, block_tables)
     out = _run_decode(q4, key_cache, value_cache, block_tables, lengths,
                       scale, paged=True)
     return out.reshape(B, H, D)
+
+
+def _consult_tuner_paged(q4, kc, tables):
+    """The paged kernel's tile (page_size, D) is the cache POOL's physical
+    layout — tunable at pool construction, not per launch — so the only
+    candidate is the layout itself. Consulting the tuner anyway keeps all
+    five Pallas kernels uniform in telemetry: the tile lands in
+    chosen_tiles() / the step-timeline record as source "fixed" (the
+    single-candidate consult never sweeps and never counts a fallback)."""
+    from .autotune import pick_block_sizes
+
+    B, Hkv, g, D = q4.shape
+    ps = kc.shape[2]
+    pick_block_sizes(
+        "decode_paged", 1, ps, (ps, D), lambda bq, bk: None,
+        allow_measure=False,
+        signature=(B, Hkv, g, D, str(q4.dtype), tables.shape[1]),
+        candidates=[(ps, D)])
 
 
 def paged_kv_write(cache, new, block_tables, lengths):
@@ -179,6 +207,34 @@ def paged_kv_write(cache, new, block_tables, lengths):
     return cache.at[page, :, lengths % ps].set(new.astype(cache.dtype))
 
 
+def _tuned_dense_ps(q4, kc, vc, lengths, scale):
+    """Dense-decode sequence tile, autotuned per signature when
+    PADDLE_TPU_AUTOTUNE=1 — candidates are the powers of two dividing the
+    static cache capacity (decode streams the whole cache once; the tile
+    trades DMA granularity against grid overhead). Cache-only under trace."""
+    from .autotune import pick_block_sizes
+
+    B, Hkv, g, D = q4.shape
+    S_max = kc.shape[2]
+    default = (_default_dense_ps(S_max), D)
+    cands = sorted({default} | {
+        (p, D) for p in (8, 16, 32, 64, 128, 256, 512) if S_max % p == 0})
+    dummy = jnp.zeros((B, 1), jnp.int32)
+
+    def run_with(ps, _d):
+        out = _run_decode(q4, kc, vc, dummy, lengths, scale, paged=False,
+                          ps=ps)
+        jax.device_get(out.ravel()[0:1])
+
+    concrete = not any(isinstance(x, jax.core.Tracer)
+                       for x in (q4, kc, lengths))
+    ps, _ = pick_block_sizes(
+        "decode_dense", 1, S_max, default, run_with,
+        allow_measure=concrete, signature=(B, Hkv, g, D, str(q4.dtype)),
+        candidates=cands)
+    return ps
+
+
 def dense_decode_attention(q, key_cache, value_cache, lengths, scale=None):
     """MMHA analog on a dense cache: q [B, H, D]; key/value_cache
     [B, Hkv, S_max, D]; lengths [B] valid tokens incl. current. -> [B, H, D]."""
@@ -187,7 +243,8 @@ def dense_decode_attention(q, key_cache, value_cache, lengths, scale=None):
     if scale is None:
         scale = D ** -0.5
     q4, g = _split_heads(q, Hkv)
+    ps = _tuned_dense_ps(q4, key_cache, value_cache, lengths, scale)
     dummy_tables = jnp.zeros((B, 1), jnp.int32)
     out = _run_decode(q4, key_cache, value_cache, dummy_tables, lengths,
-                      scale, paged=False)
+                      scale, paged=False, ps=ps)
     return out.reshape(B, H, D)

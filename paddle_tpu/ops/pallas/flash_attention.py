@@ -663,13 +663,15 @@ _flash_kb.defvjp(_flash_kb_vjp_fwd, _flash_kb_vjp_bwd)
 
 def _tuned_blocks(q, k, v, causal, scale):
     """Forward block sizes, autotuned per (seq, kv-seq) signature when
-    PADDLE_TPU_AUTOTUNE=1 (reference: phi/kernels/autotune cache)."""
-    from .autotune import autotune_enabled, pick_block_sizes
+    PADDLE_TPU_AUTOTUNE=1 (reference: phi/kernels/autotune cache). Always
+    goes through pick_block_sizes — disabled runs return the default fast
+    but still land the chosen tile in the telemetry registry
+    (autotune.chosen_tiles), so the step-timeline JSONL and bench perf line
+    can attribute MFU movement to tile choices."""
+    from .autotune import pick_block_sizes
 
     sq, skv = q.shape[2], k.shape[2]
     default = _block_sizes(sq, skv, d=q.shape[-1])
-    if not autotune_enabled():
-        return default
 
     def run_with(bq, bk):
         out, _ = _fwd(_pad_seq(q, bq), _pad_seq(k, bk), _pad_seq(v, bk),

@@ -377,6 +377,34 @@ def _flashmask_vjp_bwd(causal, scale, bq, bk, saved, dout):
 _flashmask.defvjp(_flashmask_vjp_fwd, _flashmask_vjp_bwd)
 
 
+def _tuned_blocks_fm(q, k, v, idx, causal, scale):
+    """Forward block sizes for the flashmask kernel ([B,H,S,D] layout),
+    autotuned per signature when PADDLE_TPU_AUTOTUNE=1 — previously this
+    kernel ran fixed _block_sizes defaults and the tuning env var silently
+    did nothing for it. Under a jit trace only the cache is consulted
+    (allow_measure=False); misses are counted as fallbacks and warned."""
+    from .autotune import pick_block_sizes
+
+    sq, skv = q.shape[2], k.shape[2]
+    default = _block_sizes(sq, skv, d=q.shape[-1])
+
+    def run_with(bq, bk):
+        qp = _pad_seq(q, bq)
+        kp = _pad_seq(k, bk)
+        vp = _pad_seq(v, bk)
+        idxp = jnp.pad(idx, ((0, 0), (0, 0), (0, 0),
+                             (0, kp.shape[2] - skv)))
+        out, _ = _fm_fwd(qp, kp, vp, idxp, scale, causal, sq, skv, bq, bk)
+        jax.device_get(out.ravel()[0:1])  # real fetch, see flash tuner
+
+    concrete = not any(isinstance(x, jax.core.Tracer)
+                       for x in (q, k, v, idx))
+    return pick_block_sizes(
+        "flashmask_fwd", sq, skv, default, run_with, allow_measure=concrete,
+        signature=(q.shape[0], q.shape[1], k.shape[1], idx.shape[1],
+                   idx.shape[2], q.shape[-1], str(q.dtype), bool(causal)))
+
+
 def flashmask_attention_fwd(q, k, v, startend_row_indices, causal=True,
                             scale=None):
     """Paddle-layout entry: q [B,Sq,H,D], k/v [B,Skv,Hkv,D],
@@ -387,7 +415,7 @@ def flashmask_attention_fwd(q, k, v, startend_row_indices, causal=True,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     idx = jnp.moveaxis(startend_row_indices.astype(jnp.int32), 2, 3)  # [B,Hm,n,Sk]
-    bq, bk = _block_sizes(qt.shape[2], kt.shape[2], d=qt.shape[-1])
+    bq, bk = _tuned_blocks_fm(qt, kt, vt, idx, causal, scale)
     out = _flashmask(qt, kt, vt, idx, causal, scale, bq, bk)
     return jnp.swapaxes(out, 1, 2)
 
@@ -698,6 +726,35 @@ def _varlen_vjp_bwd(causal, scale, bq, bk, saved, dout):
 _varlen.defvjp(_varlen_vjp_fwd, _varlen_vjp_bwd)
 
 
+def _tuned_blocks_vl(q, k, v, seg_q, seg_k, pos_q, pos_k, causal, scale):
+    """Forward block sizes for the varlen kernel ([H,T,D] packed layout),
+    autotuned per signature when PADDLE_TPU_AUTOTUNE=1 (cache-only under
+    trace, fallback-counted on miss — see autotune.pick_block_sizes)."""
+    from .autotune import pick_block_sizes
+
+    tq, tk = q.shape[1], k.shape[1]
+    default = _block_sizes(tq, tk, d=q.shape[-1])
+
+    def run_with(bq, bk):
+        qp = _pad_tokens(q, bq)
+        kp = _pad_tokens(k, bk)
+        vp = _pad_tokens(v, bk)
+        sqp = _pad_vec(seg_q, bq, -1)[:, None]
+        skp = _pad_vec(seg_k, bk, -2)[None, :]
+        pqp = _pad_vec(pos_q, bq, 0)[:, None]
+        pkp = _pad_vec(pos_k, bk, 0)[None, :]
+        out, _ = _vl_fwd(qp, kp, vp, sqp, skp, pqp, pkp, scale, causal, tq,
+                         tk, bq, bk)
+        jax.device_get(out.ravel()[0:1])  # real fetch, see flash tuner
+
+    concrete = not any(isinstance(x, jax.core.Tracer)
+                       for x in (q, k, v, seg_q, seg_k))
+    return pick_block_sizes(
+        "varlen_fwd", tq, tk, default, run_with, allow_measure=concrete,
+        signature=(q.shape[0], k.shape[0], q.shape[-1], str(q.dtype),
+                   bool(causal)))
+
+
 def varlen_flash_attention_fwd(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
                                causal=False):
     """Packed varlen entry: q [Tq,H,D], k/v [Tk,Hkv,D], cu_seqlens [B+1].
@@ -712,7 +769,8 @@ def varlen_flash_attention_fwd(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
     qt = jnp.swapaxes(q, 0, 1)  # [H, T, D]
     kt = jnp.swapaxes(k, 0, 1)
     vt = jnp.swapaxes(v, 0, 1)
-    bq, bk = _block_sizes(Tq, Tk, d=qt.shape[-1])
+    bq, bk = _tuned_blocks_vl(qt, kt, vt, seg_q, seg_k, pos_q, pos_k,
+                              causal, scale)
     out = _varlen(qt, kt, vt, seg_q, seg_k, pos_q, pos_k, causal, scale,
                   bq, bk)
     return jnp.swapaxes(out, 0, 1)

@@ -91,9 +91,9 @@ def test_flash_entry_consults_tuner(monkeypatch):
     monkeypatch.setattr(autotune, "autotune_enabled", lambda: True)
 
     B, S, H, D = 1, 512, 2, 32
-    # seed the winner for this exact signature
-    key = (f"flash_fwd|{autotune._device_kind()}|{S}|{S}|"
-           f"{B}|{H}|{H}|{D}|float32|True")
+    # seed the winner for this exact signature (device + jaxlib keyed)
+    key = (f"flash_fwd|{autotune._device_kind()}|{autotune._jaxlib_version()}"
+           f"|{S}|{S}|{B}|{H}|{H}|{D}|float32|True")
     autotune._memory[key] = [256, 256]
     autotune._disk_loaded[0] = True
 
@@ -123,6 +123,135 @@ def test_flash_entry_default_under_interpret(monkeypatch):
     q = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
     out = flash_attention_fwd(q, q, q, causal=True)
     assert out.shape == q.shape and bool(jnp.isfinite(out).all())
+
+
+def test_jaxlib_version_in_disk_key(monkeypatch):
+    """A jaxlib upgrade must invalidate tuned winners: the cache key embeds
+    the jaxlib version, so a winner stored under the old version misses."""
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+    autotune.pick_block_sizes("kver", 256, 256, (128, 128),
+                              lambda bq, bk: None, reps=1)
+    (key,) = [k for k in autotune._memory if k.startswith("kver|")]
+    assert f"|{autotune._jaxlib_version()}|" in key
+
+    # same signature under a different jaxlib version: cache miss
+    monkeypatch.setattr(autotune, "_jaxlib_version", lambda: "9.9.9")
+    calls = []
+    autotune.pick_block_sizes("kver", 256, 256, (128, 128),
+                              lambda bq, bk: calls.append(1), reps=1)
+    assert calls, "stale winner survived a jaxlib upgrade"
+
+
+def test_trace_miss_counts_fallback_and_warns_once(monkeypatch):
+    """PADDLE_TPU_AUTOTUNE=1 + jit trace + cache miss used to silently run
+    defaults; now it counts pallas_autotune_fallbacks_total{kernel=} and
+    warns ONCE naming the key."""
+    import warnings
+
+    from paddle_tpu.observability.metrics import reset_default_registry
+
+    reg = reset_default_registry()
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = autotune.pick_block_sizes("kfb", 256, 256, (128, 128),
+                                        lambda bq, bk: None,
+                                        allow_measure=False)
+        again = autotune.pick_block_sizes("kfb", 256, 256, (128, 128),
+                                          lambda bq, bk: None,
+                                          allow_measure=False)
+    assert out == (128, 128) and again == (128, 128)
+    hits = [x for x in w if "kfb" in str(x.message)]
+    assert len(hits) == 1, "fallback warning must fire once per key"
+    assert "PADDLE_TPU_AUTOTUNE" in str(hits[0].message)
+    ctr = reg.get("pallas_autotune_fallbacks_total")
+    assert ctr is not None and ctr.value(kernel="kfb") == 2
+    tiles = autotune.chosen_tiles()
+    assert tiles["kfb"]["source"] == "default"
+    assert tiles["kfb"]["fallbacks"] == 2
+
+
+def test_hit_and_miss_counters(monkeypatch):
+    from paddle_tpu.observability.metrics import reset_default_registry
+
+    reg = reset_default_registry()
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+    autotune.pick_block_sizes("khm", 256, 256, (128, 128),
+                              lambda bq, bk: None, reps=1)
+    autotune.pick_block_sizes("khm", 256, 256, (128, 128),
+                              lambda bq, bk: None, reps=1)
+    assert reg.get("pallas_autotune_misses_total").value(kernel="khm") == 1
+    assert reg.get("pallas_autotune_hits_total").value(kernel="khm") == 1
+    assert autotune.chosen_tiles()["khm"]["source"] == "tuned"
+
+
+def test_custom_candidates_override_grid(monkeypatch):
+    """Kernels with a non-attention tunable (fused norm row block, dense
+    decode page tile) pass their own candidate list."""
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+    seen = []
+
+    def run_with(bq, bk):
+        seen.append((bq, bk))
+
+    best = autotune.pick_block_sizes(
+        "kcand", 512, 384, (64, 384), run_with, reps=1,
+        candidates=[(64, 384), (128, 384)])
+    assert set(seen) == {(64, 384), (128, 384)}
+    assert best in {(64, 384), (128, 384)}
+
+
+def test_disabled_still_records_default_tile(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_AUTOTUNE", raising=False)
+    out = autotune.pick_block_sizes("kdef", 512, 512, (256, 512),
+                                    lambda bq, bk: None)
+    assert out == (256, 512)
+    rec = autotune.chosen_tiles()["kdef"]
+    assert rec == {"bq": 256, "bk": 512, "source": "default"}
+
+
+def test_all_pallas_kernels_consult_tuner(monkeypatch):
+    """Acceptance: every Pallas kernel entry lands a tile in the registry —
+    flash, flashmask, varlen, dense+paged decode, fused norm, fused rope."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    from paddle_tpu.ops.pallas.decode_attention import (
+        dense_decode_attention, paged_decode_attention)
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+    from paddle_tpu.ops.pallas.fused_norm import layer_norm_fwd, rms_norm_fwd
+    from paddle_tpu.ops.pallas.fused_rope import apply_fused_rope
+    from paddle_tpu.ops.pallas.masked_flash import (
+        flashmask_attention_fwd, varlen_flash_attention_fwd)
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 64, 2, 32)), jnp.float32)
+    flash_attention_fwd(q, q, q, causal=True)
+    idx = jnp.full((1, 1, 64, 1), 64, jnp.int32)
+    flashmask_attention_fwd(q, q, q, idx, causal=True)
+    qp = jnp.asarray(rng.standard_normal((48, 2, 32)), jnp.float32)
+    cu = jnp.asarray([0, 20, 48], jnp.int32)
+    varlen_flash_attention_fwd(qp, qp, qp, cu, cu, 0.17, causal=True)
+    qd = jnp.asarray(rng.standard_normal((2, 4, 32)), jnp.float32)
+    dense = jnp.asarray(rng.standard_normal((2, 2, 64, 32)), jnp.float32)
+    dense_decode_attention(qd, dense, dense, jnp.asarray([5, 9], jnp.int32))
+    paged = jnp.asarray(rng.standard_normal((4, 2, 8, 32)), jnp.float32)
+    tables = jnp.asarray([[1, 2], [3, -1]], jnp.int32)
+    paged_decode_attention(qd, paged, paged, tables,
+                           jnp.asarray([10, 5], jnp.int32))
+    x = jnp.asarray(rng.standard_normal((2, 40, 96)), jnp.float32)
+    rms_norm_fwd(x, None)
+    layer_norm_fwd(x, None, None)
+    c = jnp.cos(jnp.ones((1, 64, 16), jnp.float32))
+    s = jnp.sin(jnp.ones((1, 64, 16), jnp.float32))
+    apply_fused_rope((q,), c, s)
+
+    tiles = autotune.chosen_tiles()
+    for kernel in ("flash_fwd", "flashmask_fwd", "varlen_fwd",
+                   "decode_dense", "decode_paged", "fused_rms_norm",
+                   "fused_layer_norm", "fused_rope"):
+        assert kernel in tiles, (kernel, sorted(tiles))
+        assert tiles[kernel]["bq"] > 0 and tiles[kernel]["bk"] > 0
 
 
 class TestSetConfig:
