@@ -22,6 +22,12 @@ unet_sd serving cpu_smoke. `serving` drives the paged-KV engine
 (docs/SERVING.md) and reports tokens/sec at the p99 token latency it
 measured, plus TTFT percentiles; with --emit-metrics the serving SLO
 registry series is appended to the JSONL once per scheduler tick.
+
+`--plan` prints the mesh planner's analytic top-K shortlist + cost
+breakdown for the selected rung config (docs/PLANNER.md) without timing
+anything — BENCH_PLAN_DEVICES sizes the grid, and
+PADDLE_TPU_PLAN_OVERLAP_JSONL feeds measured overlap history into the
+hybrid cost model.
 """
 
 import json
@@ -34,28 +40,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import jax
 import numpy as np
 
-# chip kind -> peak bf16 FLOP/s (public spec sheets)
-_PEAK = {
-    "TPU v2": 22.5e12,
-    "TPU v3": 61.0e12,  # per chip (2 cores)
-    "TPU v4": 137.5e12,  # per chip (megacore)
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 229.5e12,
-    "TPU v5p": 229.5e12,
-    "TPU v6 lite": 459e12,
-    "TPU v6e": 459e12,
-    "TPU7x": 2307e12,
-}
-
-
 def _peak_flops(device):
+    """Chip kind -> peak bf16 FLOP/s, resolved through the mesh planner's
+    chip spec table (paddle_tpu/distributed/planner/cost_model.py) so the
+    bench MFU denominator and the planner's compute term can never disagree
+    about what a chip can do. Imported lazily — paddle_tpu must not load
+    before _probe_backend() decides whether to pin jax_platforms=cpu."""
+    from paddle_tpu.distributed.planner.cost_model import PEAK_BF16_FLOPS
+
     kind = getattr(device, "device_kind", "")
-    for k, v in _PEAK.items():
+    for k, v in PEAK_BF16_FLOPS.items():
         if kind.startswith(k) or k in kind:
             return v, kind
     # CPU smoke runs / unknown chips: assume v4-class so the line still prints
-    return 137.5e12, kind or "unknown"
+    return PEAK_BF16_FLOPS["TPU v4"], kind or "unknown"
 
 
 def _probe_backend(max_tries=2, timeout_s=180.0):
@@ -190,6 +188,16 @@ def _emit(name, dt, flops, tokens=None, extra=None):
 # --------------------------------------------------------------------------- #
 
 
+def _cpu_smoke_cfg():
+    """The degraded-run model shape, shared by the gpt ladder's fallback
+    rung and `--plan` so the planned config is always the config the
+    cpu_smoke rung actually measures."""
+    from paddle_tpu.models import GPTConfig
+
+    return GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
+                     vocab_size=8192, max_position_embeddings=512)
+
+
 def _decoder_flops(cfg, batch, seq):
     """6ND fwd+bwd + attention quadratic term (12*L*h*T^2 per token batch)."""
     n_params = (cfg.num_params(include_embeddings=False)
@@ -278,10 +286,7 @@ def run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir=None):
             return gpt3_350m(max_position_embeddings=2048), 8, 2048, 10
         if name == "gpt3_125m":
             return gpt3_125m(max_position_embeddings=2048), 8, 2048, 10
-        from paddle_tpu.models import GPTConfig
-        return (GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
-                          vocab_size=8192, max_position_embeddings=512),
-                2, 256, 3)
+        return _cpu_smoke_cfg(), 2, 256, 3
 
     ladder = [cfg_name] if cfg_name else (
         ["gpt3_1p3b", "gpt3_350m", "gpt3_125m"] if on_tpu else ["cpu_smoke"])
@@ -588,6 +593,80 @@ def run_serving_rung(on_tpu, metrics_path=None):
             os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = interp_prev
 
 
+def run_plan(on_tpu, top_k=None):
+    """`--plan`: the mesh planner's analytic shortlist + cost breakdown for
+    the current rung config — one JSON line per shortlisted candidate and a
+    final mesh_plan_shortlist line. Pure analytic: nothing is measured, so
+    this exits 0 on the CPU smoke config and the bench harness can gate it.
+
+    Env: BENCH_PLAN_DEVICES (default: live device count), BENCH_PLAN_TOP_K,
+    BENCH_PLAN_GBS, BENCH_CONFIG picks the model shape (cpu_smoke default
+    off-TPU), PADDLE_TPU_PLAN_OVERLAP_JSONL feeds the measured
+    overlap_fraction half of the hybrid cost model."""
+    from paddle_tpu.distributed.planner import CostModel, rank_candidates
+    from paddle_tpu.models import gpt3_1p3b, gpt3_125m, gpt3_350m
+
+    cfg_name = os.environ.get("BENCH_CONFIG") or (
+        "gpt3_1p3b" if on_tpu else "cpu_smoke")
+    builders = {"gpt3_1p3b": gpt3_1p3b, "gpt3_350m": gpt3_350m,
+                "gpt3_125m": gpt3_125m}
+    if cfg_name in builders:
+        c = builders[cfg_name](max_position_embeddings=2048)
+        seq = 2048
+    else:
+        c = _cpu_smoke_cfg()
+        seq = 256
+    ndev = int(os.environ.get("BENCH_PLAN_DEVICES", "0")) or len(jax.devices())
+    top_k = top_k or int(os.environ.get("BENCH_PLAN_TOP_K", "5"))
+    tuner_cfg = {
+        "num_devices": ndev,
+        "global_batch_size": int(os.environ.get("BENCH_PLAN_GBS", "0"))
+        or max(8, ndev),
+        "model_cfg": {"hidden_size": c.hidden_size,
+                      "num_layers": c.num_layers,
+                      "num_heads": c.num_heads,
+                      "vocab_size": c.vocab_size,
+                      "seq_length": seq},
+    }
+    cm = CostModel(device=jax.devices()[0])
+    ranked, pruned = rank_candidates(tuner_cfg, cm)
+    for rank, (cfg, bd) in enumerate(ranked[:top_k], 1):
+        print(json.dumps({
+            "metric": "plan_candidate", "rank": rank,
+            "dp": cfg["dp_degree"], "mp": cfg["mp_degree"],
+            "pp": cfg["pp_degree"], "sharding": cfg["sharding_degree"],
+            "sharding_stage": cfg.get("sharding_stage", 1)
+            if cfg["sharding_degree"] > 1 else 0,
+            "micro_batch_size": cfg["micro_batch_size"],
+            "use_recompute": cfg["use_recompute"],
+            "predicted_step_time_s": bd["total_s"],
+            "compute_s": bd["compute_s"], "bubble_s": bd["bubble_s"],
+            "exposed_comm_s": bd["exposed_comm_s"],
+            "comm_s_by_axis": bd["comm_s_by_axis"],
+            "mem_estimate_gb": round(bd["mem_estimate_bytes"] / 1e9, 3),
+            "n_micro": bd["n_micro"],
+        }), flush=True)
+    top = ranked[0][0] if ranked else None
+    line = {
+        "metric": f"mesh_plan_shortlist_{cfg_name}",
+        "value": len(ranked[:top_k]),
+        "unit": "candidates",
+        "vs_baseline": 0.0,
+        "num_devices": ndev,
+        "candidates_ranked": len(ranked),
+        "candidates_pruned": len(pruned),
+        "overlap_fraction": cm.overlap_fraction,
+        "overlap_source": cm.overlap_source,
+        "chip": cm.chip,
+        "top": (None if top is None else
+                f"dp{top['dp_degree']}xpp{top['pp_degree']}"
+                f"xsharding{top['sharding_degree']}xmp{top['mp_degree']}"
+                f"/mbs{top['micro_batch_size']}"),
+    }
+    print(json.dumps(line), flush=True)
+    return line
+
+
 def main():
     # --emit-metrics[=path]: step-timeline JSONL alongside the perf line
     # (env-var style config everywhere else; this one is a flag so BENCH
@@ -613,6 +692,11 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         backend = "cpu"
     on_tpu = backend not in ("cpu",)
+    if "--plan" in sys.argv[1:]:
+        # analytic-only: nothing is measured, so a degraded (wedged-tunnel)
+        # run still plans — on CPU, with the v4-class spec fallback
+        run_plan(on_tpu and not init_error)
+        return
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     cfg_name = os.environ.get("BENCH_CONFIG")
     matrix = os.environ.get("BENCH_MATRIX")

@@ -48,7 +48,8 @@ from .env import (
 )
 from .parallel import DataParallel, group_sharded_parallel
 from .train_step import DistributedTrainStep
-from . import auto_parallel, checkpoint, resilience
+from . import auto_parallel, checkpoint, planner, resilience
+from .planner import MeshPlan
 from .resilience import ResilientTrainer, run_with_recovery
 from .auto_parallel import (
     Partial,
@@ -74,6 +75,7 @@ __all__ = [
     "destroy_process_group", "fleet", "collective", "DataParallel",
     "group_sharded_parallel", "DistributedTrainStep", "sharding",
     "resilience", "ResilientTrainer", "run_with_recovery",
+    "planner", "MeshPlan",
 ]
 
 

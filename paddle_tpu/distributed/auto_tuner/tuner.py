@@ -93,30 +93,60 @@ def prune_by_mbs(tuner_cfg, cfg, history=None):
     return None
 
 
+def params_per_device(model_cfg, cfg):
+    """(body_elems, emb_elems) on the worst-case device under the actual
+    DistributedTrainStep placement — THE one encoding of the split rules,
+    shared by the memory estimate below and the planner's HBM/comm terms
+    (planner/cost_model.py) so they can never diverge:
+
+    - the transformer body (12*L*h^2 params) is split by mp (TP column/row
+      specs) and pp (layer partition); sharding stage 3 (FSDP) splits it
+      by `sharding` as well;
+    - the vocab embedding (vocab*h) is vocab-sharded by mp ONLY
+      (VocabParallelEmbedding P("mp", None)); it lives on one pipeline
+      stage, so pp does NOT divide it — worst case is the stage that owns
+      it. Stage 3 adds the `sharding` split on its free h dim (fsdp_spec
+      respects the TP-taken vocab dim).
+    """
+    h = model_cfg.get("hidden_size", 0)
+    L = model_cfg.get("num_layers", 0)
+    vocab = model_cfg.get("vocab_size", 0)
+    mp, pp = cfg["mp_degree"], cfg["pp_degree"]
+    sh = max(cfg["sharding_degree"], 1)
+    stage = cfg.get("sharding_stage", 1) if sh > 1 else 0
+    body_dev = 12 * L * h * h / (mp * pp)
+    emb_dev = vocab * h / mp
+    if stage >= 3:
+        body_dev /= sh
+        emb_dev /= sh
+    return body_dev, emb_dev
+
+
 def estimate_memory_bytes(tuner_cfg, cfg):
     """Per-device parameter+optimizer+activation estimate (reference
-    memory_cost_model.py). AdamW f32 states + bf16 params; activations per
-    microbatch with optional recompute."""
+    memory_cost_model.py) over the `params_per_device` placement: bf16
+    params are 2 B/elem; optimizer states (f32 master + two f32 moments)
+    are 12 B/elem and are `sharding`-split at every stage >= 1 (ZeRO-1),
+    while the params themselves stay unsplit below stage 3 (stage 3's
+    split already happened in params_per_device)."""
     model = tuner_cfg.get("model_cfg", {})
     h = model.get("hidden_size", 0)
     L = model.get("num_layers", 0)
-    vocab = model.get("vocab_size", 0)
     seq = model.get("seq_length", 1024)
     if not h:
         return 0
-    n_params = 12 * L * h * h + vocab * h
-    shard = cfg["mp_degree"] * cfg["pp_degree"] * (
-        cfg["sharding_degree"] if cfg.get("sharding_stage", 1) >= 3 else 1)
-    state_bytes = n_params * (2 + 4 + 4 + 4) / max(shard, 1)
-    if cfg.get("sharding_stage", 1) in (1, 2):
-        state_bytes = (n_params * 2 / (cfg["mp_degree"] * cfg["pp_degree"])
-                       + n_params * 12 / max(
-                           cfg["mp_degree"] * cfg["pp_degree"]
-                           * cfg["sharding_degree"], 1))
-    act_layers = 1 if cfg.get("use_recompute") else L // cfg["pp_degree"]
-    act_bytes = (cfg["micro_batch_size"] * seq * h * 16 * act_layers
-                 / cfg["mp_degree"])
-    return state_bytes + act_bytes
+    mp, pp = cfg["mp_degree"], cfg["pp_degree"]
+    sh = max(cfg["sharding_degree"], 1)
+    stage = cfg.get("sharding_stage", 1) if sh > 1 else 0
+    body_dev, emb_dev = params_per_device(model, cfg)
+    param_bytes = 2 * (body_dev + emb_dev)
+    if stage >= 3:
+        state_bytes = 12 * (body_dev + emb_dev)
+    else:
+        state_bytes = 12 * (body_dev + emb_dev) / sh
+    act_layers = 1 if cfg.get("use_recompute") else L // pp
+    act_bytes = (cfg["micro_batch_size"] * seq * h * 16 * act_layers / mp)
+    return param_bytes + state_bytes + act_bytes
 
 
 def prune_by_memory(tuner_cfg, cfg, history=None):
@@ -133,7 +163,7 @@ def prune_by_history(tuner_cfg, cfg, history):
     (reference prune_by_*_history)."""
     est = estimate_memory_bytes(tuner_cfg, cfg)
     for h in history or []:
-        if h.get("error") == "oom" and est >= h.get("mem_estimate", 0):
+        if h.get("error") == "oom" and est >= (h.get("mem_estimate") or 0):
             return f"memory {est / 1e9:.2f} GB >= known OOM config"
     return None
 
@@ -175,11 +205,37 @@ class Recorder:
             for h in self.history:
                 w.writerow(h)
 
+    @staticmethod
+    def _coerce(row):
+        """csv.DictReader returns all-string rows; restore the types the
+        history was recorded with, or every numeric comparison downstream
+        (prune_by_history's `est >= mem_estimate`) raises TypeError.
+        "" round-trips to None (store_history writes None as empty),
+        True/False back to bool, numerics to int-then-float, everything
+        else stays a string (error reasons, pruned reasons)."""
+        out = {}
+        for k, v in row.items():
+            if v is None or v == "":
+                out[k] = None
+            elif v == "True":
+                out[k] = True
+            elif v == "False":
+                out[k] = False
+            else:
+                try:
+                    out[k] = int(v)
+                except ValueError:
+                    try:
+                        out[k] = float(v)
+                    except ValueError:
+                        out[k] = v
+        return out
+
     def load_history(self, path="./history.csv"):
         if not os.path.exists(path):
             return [], True
         with open(path) as f:
-            return list(csv.DictReader(f)), False
+            return [self._coerce(r) for r in csv.DictReader(f)], False
 
 
 # --------------------------------------------------------------------------- #
@@ -197,7 +253,9 @@ class AutoTuner:
         self.task_limit = tuner_cfg.get("task_limit", 100)
         self.cur_task_id = 0
         self.history_cfgs: list[dict] = []
-        self.pruned: list[tuple[dict, str]] = []
+        # (cfg, prune-rule name, reason) — the rule is recorded at the
+        # point it fires so reports never have to re-derive it
+        self.pruned: list[tuple[dict, str, str]] = []
         self._iter = iter(self.candidates)
 
     def search_once(self):
@@ -211,7 +269,7 @@ class AutoTuner:
             for prune in _PRUNES:
                 reason = prune(self.tuner_cfg, cfg, self.history_cfgs)
                 if reason:
-                    self.pruned.append((cfg, reason))
+                    self.pruned.append((cfg, prune.__name__, reason))
                     break
             if reason:
                 continue
@@ -248,42 +306,57 @@ def tune(model_builder, loss_fn, optimizer_builder, tuner_cfg, devices=None,
     seq = model_cfg.get("seq_length", 128)
     vocab = model_cfg.get("vocab_size", 1024)
 
-    while True:
-        cfg = tuner.search_once()
-        if cfg is None:
-            break
-        entry = dict(cfg)
-        entry["mem_estimate"] = estimate_memory_bytes(tuner_cfg, cfg)
-        try:
-            paddle.seed(0)
-            mesh = _env.build_mesh(
-                dp=cfg["dp_degree"], pp=cfg["pp_degree"],
-                sharding=cfg["sharding_degree"], mp=cfg["mp_degree"],
-                devices=devices)
-            model = model_builder(cfg)
-            optimizer = optimizer_builder(model)
-            step = DistributedTrainStep(
-                model, loss_fn, optimizer, mesh=mesh,
-                sharding_stage=cfg.get("sharding_stage", 1)
-                if cfg["sharding_degree"] > 1 else 0)
-            rng = np.random.default_rng(0)
-            ids = paddle.to_tensor(rng.integers(0, vocab, (gbs, seq)))
-            labels = paddle.to_tensor(rng.integers(0, vocab, (gbs, seq)))
-            _ = float(step(ids, labels))  # compile + warmup
-            t0 = time.perf_counter()
-            for _i in range(steps):
-                loss = step(ids, labels)
-            entry["loss"] = float(loss)
-            entry["step_time"] = (time.perf_counter() - t0) / steps
-        except Exception as e:  # OOM / infeasible compile
-            msg = str(e).lower()
-            entry["error"] = ("oom" if "resource exhausted" in msg
-                              or "out of memory" in msg else
-                              f"{type(e).__name__}")
-        finally:
-            _env.set_global_mesh(None)
-        tuner.add_cfg(entry)
-        recorder.add_cfg(**entry)
+    # the sweep must not clobber the caller's mesh: every trial sets the
+    # global mesh (build_mesh AND DistributedTrainStep both do), so snapshot
+    # it here and restore it when the sweep ends, however it ends. The
+    # per-trial `finally` below is unconditional-safe: it runs whether the
+    # failure came from build_mesh, model_builder, or the timed loop — a
+    # model_builder raise must not leave the PREVIOUS trial's mesh visible.
+    prev_mesh = _env.get_global_mesh()
+    try:
+        while True:
+            cfg = tuner.search_once()
+            if cfg is None:
+                break
+            entry = dict(cfg)
+            entry["mem_estimate"] = estimate_memory_bytes(tuner_cfg, cfg)
+            try:
+                paddle.seed(0)
+                mesh = _env.build_mesh(
+                    dp=cfg["dp_degree"], pp=cfg["pp_degree"],
+                    sharding=cfg["sharding_degree"], mp=cfg["mp_degree"],
+                    devices=devices)
+                model = model_builder(cfg)
+                optimizer = optimizer_builder(model)
+                step = DistributedTrainStep(
+                    model, loss_fn, optimizer, mesh=mesh,
+                    sharding_stage=cfg.get("sharding_stage", 1)
+                    if cfg["sharding_degree"] > 1 else 0)
+                rng = np.random.default_rng(0)
+                ids = paddle.to_tensor(rng.integers(0, vocab, (gbs, seq)))
+                labels = paddle.to_tensor(rng.integers(0, vocab, (gbs, seq)))
+                _ = float(step(ids, labels))  # compile + warmup
+                t0 = time.perf_counter()
+                for _i in range(steps):
+                    loss = step(ids, labels)
+                entry["loss"] = float(loss)
+                entry["step_time"] = (time.perf_counter() - t0) / steps
+            except Exception as e:  # OOM / infeasible compile
+                msg = str(e).lower()
+                entry["error"] = ("oom" if "resource exhausted" in msg
+                                  or "out of memory" in msg else
+                                  f"{type(e).__name__}")
+            finally:
+                _env.set_global_mesh(None)
+            tuner.add_cfg(entry)
+            recorder.add_cfg(**entry)
+    finally:
+        _env.set_global_mesh(prev_mesh)
+
+    # pruned configs land in the history too, so shortlist reports can show
+    # WHY a config was never measured (tools/plan_report.py prints these)
+    for cfg, _rule, reason in tuner.pruned:
+        recorder.add_cfg(**dict(cfg), pruned=reason)
 
     best, _err = recorder.get_best()
     return best, recorder

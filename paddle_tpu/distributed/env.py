@@ -151,6 +151,16 @@ def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None):
     return mesh
 
 
+def mesh_shape(mesh=None) -> dict:
+    """axis -> size of `mesh` (default: the global mesh) over AXIS_ORDER,
+    with absent axes reported as 1 — the shape the planner's MeshPlan
+    artifact stores, so a live mesh and a stored plan compare directly."""
+    m = mesh if mesh is not None else get_global_mesh()
+    if m is None:
+        return {a: 1 for a in AXIS_ORDER}
+    return {a: int(m.shape.get(a, 1)) for a in AXIS_ORDER}
+
+
 def default_mesh():
     """Global mesh, defaulting to pure-dp over all devices."""
     m = get_global_mesh()

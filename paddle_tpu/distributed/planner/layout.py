@@ -1,0 +1,226 @@
+"""Canonical layout plans: SpecLayout + the serialized MeshPlan artifact.
+
+`SpecLayout` names the per-param-group PartitionSpecs the
+DistributedTrainStep placement actually uses over the hybrid mesh axes
+[dp, pp, sharding, sep, mp] — vocab-parallel embeddings, column/row TP
+linears, norms, the (dp, sharding)-sharded batch — with the stage-3 FSDP
+split folded in the same way `fsdp_spec` folds it (shard the largest free
+dim, respect dims already taken by TP).
+
+`MeshPlan` is the canonical artifact the planner emits and the
+ResilientTrainer adopts across elastic restarts: mesh shape, knobs
+(mbs/recompute/stage), per-group layouts, and the cost breakdown that
+justified the choice — serialized to JSON losslessly (docs/PLANNER.md
+documents the schema).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import asdict, dataclass, field
+
+__all__ = ["SpecLayout", "MeshPlan", "spec_to_json", "spec_from_json"]
+
+PLAN_FILENAME = "mesh_plan.json"
+_PLAN_VERSION = 1
+
+
+def spec_to_json(spec):
+    """PartitionSpec -> JSON-native list (None | str | [str, ...] entries)."""
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def spec_from_json(entries):
+    from jax.sharding import PartitionSpec as P
+
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for decoder params/activations on the hybrid
+    mesh. `fsdp` mirrors sharding stage 3: the `sharding` axis claims the
+    largest dim TP left free (exactly what `shard_params_for_stage3` +
+    `fsdp_spec` compute per-tensor at train-step construction)."""
+
+    dp_axis: str = "dp"
+    pp_axis: str = "pp"
+    sharding_axis: str = "sharding"
+    mp_axis: str = "mp"
+    fsdp: bool = False
+    batch_sharded: bool = True  # batch also split over `sharding` (ZeRO dp)
+
+    def vocab_embedding(self):
+        """[vocab, h]: vocab over mp (VocabParallelEmbedding); FSDP takes h."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.mp_axis, self.sharding_axis if self.fsdp else None)
+
+    def column_parallel(self):
+        """[in, out] with out-features over mp; FSDP takes the in dim."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.sharding_axis if self.fsdp else None, self.mp_axis)
+
+    def row_parallel(self):
+        """[in, out] with in-features over mp; FSDP takes the out dim."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.mp_axis, self.sharding_axis if self.fsdp else None)
+
+    def norm(self):
+        """1-D scale/bias: FSDP shards the only dim, else replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.sharding_axis) if self.fsdp else P()
+
+    def replicated(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P()
+
+    def activations(self):
+        """[batch, seq, h]: batch over (dp, sharding) — the train step's
+        `batch_axes` — seq/h unsharded at rest (mp constraints are applied
+        inside the layers, not at the batch boundary)."""
+        from jax.sharding import PartitionSpec as P
+
+        if self.batch_sharded:
+            return P((self.dp_axis, self.sharding_axis), None, None)
+        return P(self.dp_axis, None, None)
+
+    def groups(self) -> dict:
+        """group name -> PartitionSpec, the planner's canonical set."""
+        return {
+            "vocab_embedding": self.vocab_embedding(),
+            "column_parallel": self.column_parallel(),
+            "row_parallel": self.row_parallel(),
+            "norm": self.norm(),
+            "replicated": self.replicated(),
+            "activations": self.activations(),
+        }
+
+
+@dataclass
+class MeshPlan:
+    """The canonical plan artifact. All fields JSON-native; `layouts` holds
+    serialized PartitionSpecs (see spec_to_json) so the file round-trips
+    losslessly and diffs cleanly in review."""
+
+    mesh: dict            # axis -> size over AXIS_ORDER
+    num_devices: int
+    global_batch_size: int
+    micro_batch_size: int
+    use_recompute: bool
+    sharding_stage: int
+    layouts: dict         # group -> serialized spec
+    cost: dict            # CostModel.predict breakdown
+    predicted_step_time_s: float
+    measured_step_time_s: float | None = None
+    source: str = "analytic"     # "analytic" | "measured"
+    model_cfg: dict = field(default_factory=dict)
+    version: int = _PLAN_VERSION
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_candidate(cls, cfg, breakdown, *, model_cfg=None,
+                       measured_step_time_s=None, source="analytic"):
+        """Build a plan from a tuner candidate dict + its cost breakdown."""
+        sh = cfg["sharding_degree"]
+        stage = cfg.get("sharding_stage", 1) if sh > 1 else 0
+        layout = SpecLayout(fsdp=stage >= 3 and sh > 1, batch_sharded=sh > 1)
+        mesh = {"dp": cfg["dp_degree"], "pp": cfg["pp_degree"],
+                "sharding": sh, "sep": 1, "mp": cfg["mp_degree"]}
+        return cls(
+            mesh=mesh,
+            num_devices=int(cfg["dp_degree"] * cfg["pp_degree"]
+                            * sh * cfg["mp_degree"]),
+            global_batch_size=int(cfg.get("global_batch_size", 8)),
+            micro_batch_size=int(cfg["micro_batch_size"]),
+            use_recompute=bool(cfg.get("use_recompute", False)),
+            sharding_stage=int(stage),
+            layouts={k: spec_to_json(v)
+                     for k, v in layout.groups().items()},
+            cost=dict(breakdown),
+            predicted_step_time_s=float(breakdown["total_s"]),
+            measured_step_time_s=(None if measured_step_time_s is None
+                                  else float(measured_step_time_s)),
+            source=source,
+            model_cfg=dict(model_cfg or {}),
+        )
+
+    def tuner_candidate(self) -> dict:
+        """Back to the tuner's candidate-dict shape (plan -> re-measure)."""
+        return {
+            "dp_degree": self.mesh["dp"], "mp_degree": self.mesh["mp"],
+            "pp_degree": self.mesh["pp"],
+            "sharding_degree": self.mesh["sharding"],
+            "sharding_stage": self.sharding_stage or 1,
+            "micro_batch_size": self.micro_batch_size,
+            "use_recompute": self.use_recompute,
+            "global_batch_size": self.global_batch_size,
+        }
+
+    def partition_specs(self) -> dict:
+        """group name -> live PartitionSpec objects."""
+        return {k: spec_from_json(v) for k, v in self.layouts.items()}
+
+    def build_mesh(self, devices=None):
+        """Materialize the plan's mesh (sets the global mesh, same contract
+        as env.build_mesh)."""
+        from .. import env as _env
+
+        return _env.build_mesh(
+            dp=self.mesh["dp"], pp=self.mesh["pp"],
+            sharding=self.mesh["sharding"], sep=self.mesh.get("sep", 1),
+            mp=self.mesh["mp"], devices=devices)
+
+    def describe(self) -> str:
+        m = self.mesh
+        return (f"dp{m['dp']}xpp{m['pp']}xsharding{m['sharding']}"
+                f"xmp{m['mp']} stage{self.sharding_stage} "
+                f"mbs{self.micro_batch_size} "
+                f"rc={'on' if self.use_recompute else 'off'} "
+                f"predicted {self.predicted_step_time_s:.6f}s "
+                f"({self.source})")
+
+    # -- JSON round trip ------------------------------------------------ #
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def to_json(self, indent=2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MeshPlan":
+        d = dict(d)
+        d.pop("version", None)
+        return cls(**d, version=_PLAN_VERSION)
+
+    @classmethod
+    def from_json(cls, s: str) -> "MeshPlan":
+        return cls.from_dict(json.loads(s))
+
+    def save(self, path: str):
+        """Atomic write (tmp + rename in the target dir — the same
+        crash-safety stance as the checkpoint COMMIT protocol: a torn plan
+        file must never be adoptable)."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".plan.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(self.to_json())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    @classmethod
+    def load(cls, path: str) -> "MeshPlan":
+        with open(path) as f:
+            return cls.from_json(f.read())

@@ -105,12 +105,32 @@ class ResilientTrainer:
     step_timeout : float | None
         Per-step watchdog deadline in seconds; enables the native comm
         watchdog when set (no-op if the native lib is unavailable).
+    plan_path : str | None
+        Where the canonical MeshPlan artifact lives (docs/PLANNER.md).
+        Defaults to <ckpt_dir>/mesh_plan.json when planning is enabled, so
+        the plan travels next to the checkpoints it describes.
+    planner_cfg : dict | None
+        Tuner-config dict (model_cfg, global_batch_size, grid
+        restrictions...) enabling elastic plan adoption: on entry, if the
+        stored plan's device count differs from the current one, `run()`
+        re-plans ANALYTICALLY (no measurement — a restart is not the moment
+        to burn a cluster on trials), persists the new MeshPlan next to the
+        checkpoint, and reshard-on-load then restores the state onto the
+        new mesh. `num_devices` inside it is overridden by the live count.
+    on_plan : callable(MeshPlan) | None
+        Called with the adopted plan BEFORE resume() — the hook where the
+        caller rebuilds mesh/step/state for the plan's layout so
+        `restore_latest` reshards the checkpoint onto it.
+    plan_devices : int | None
+        Device count to plan for (default: jax.device_count() — the count
+        the restarted pod actually came back with).
     """
 
     def __init__(self, step_fn, state_dict, ckpt_dir, *, save_every=100,
                  keep_last_n=3, async_save=True, elastic=None,
                  step_timeout=None, hold_poll=1.0, hold_timeout=300.0,
-                 exit_on_reform=False, log=None):
+                 exit_on_reform=False, log=None, plan_path=None,
+                 planner_cfg=None, on_plan=None, plan_devices=None):
         self.step_fn = step_fn
         self._state_dict = state_dict
         self.manager = CheckpointManager(ckpt_dir, keep_last_n=keep_last_n,
@@ -121,6 +141,12 @@ class ResilientTrainer:
         self.hold_poll = hold_poll
         self.hold_timeout = hold_timeout
         self.exit_on_reform = exit_on_reform
+        self.plan_path = plan_path
+        self.planner_cfg = planner_cfg
+        self.on_plan = on_plan
+        self.plan_devices = plan_devices
+        self.plan = None
+        self.plan_changed = False
         self.restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
         self.resumed_from = None
         self._log = log or (lambda msg: print(f"[resilience] {msg}",
@@ -152,6 +178,61 @@ class ResilientTrainer:
         self._log(f"restart #{self.restart_count}: resumed from committed "
                   f"step {step} ({self.manager.path_for(step)})")
         return step + 1
+
+    def _adopt_plan(self):
+        """Elastic plan adoption (docs/PLANNER.md): load the MeshPlan next
+        to the checkpoint; when the device count changed (or no plan exists
+        yet) and a planner_cfg is available, re-plan analytically and
+        persist the new artifact BEFORE resume(), so an elastic job
+        migrates to a newly tuned mesh across a restart instead of merely
+        surviving one (restore_latest reshards the state onto whatever
+        mesh `on_plan` built from the adopted plan)."""
+        from .planner import MeshPlan, analytic_plan, note_replan
+        from .planner.layout import PLAN_FILENAME
+
+        path = self.plan_path or os.path.join(self.manager.root,
+                                              PLAN_FILENAME)
+        ndev = self.plan_devices
+        if ndev is None:
+            import jax
+
+            ndev = jax.device_count()
+        plan = None
+        if os.path.exists(path):
+            try:
+                plan = MeshPlan.load(path)
+            except Exception as e:
+                # a torn/corrupt plan is re-derivable state, unlike a
+                # checkpoint: log and fall through to re-planning
+                self._log(f"mesh plan at {path} unreadable "
+                          f"({type(e).__name__}: {e}); re-planning")
+        if plan is not None and plan.num_devices == ndev:
+            self.plan = plan
+            self._log(f"mesh plan: adopted {path} ({plan.describe()})")
+        elif self.planner_cfg is None:
+            self.plan = plan
+            if plan is not None:
+                self._log(
+                    f"mesh plan: {path} was planned for {plan.num_devices} "
+                    f"devices but {ndev} are live; no planner_cfg given, "
+                    "keeping the stale plan (pass planner_cfg to re-plan)")
+        else:
+            old = plan.num_devices if plan is not None else None
+            new_plan = analytic_plan(dict(self.planner_cfg,
+                                          num_devices=ndev))
+            new_plan.save(path)
+            self.plan = new_plan
+            self.plan_changed = True
+            note_replan(old, ndev)
+            _flight.get_recorder().note(
+                "mesh_plan_adopted", old_devices=old, new_devices=ndev,
+                mesh=dict(new_plan.mesh),
+                predicted_step_time_s=new_plan.predicted_step_time_s)
+            self._log(f"mesh plan: re-planned for {ndev} devices "
+                      f"(was {old}) -> {path} ({new_plan.describe()})")
+        if self.plan is not None and self.on_plan is not None:
+            self.on_plan(self.plan)
+        return self.plan
 
     # ------------------------------------------------------------------ #
 
@@ -252,6 +333,10 @@ class ResilientTrainer:
         # SIGTERM (preemption) + uncaught-exception post-mortems; chained
         # and idempotent, path from PADDLE_FLIGHT_FILE (set by the launcher)
         _flight.install_crash_handlers()
+        if self.planner_cfg is not None or self.plan_path is not None:
+            # adopt/re-plan the mesh BEFORE restore: on_plan rebuilds the
+            # state views, then resume() reshards the checkpoint onto them
+            self._adopt_plan()
         start = self.resume()
         recorder.note("trainer_start", start_step=start,
                       resumed_from=self.resumed_from,

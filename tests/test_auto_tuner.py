@@ -95,6 +95,128 @@ def test_recorder_best_and_csv(tmp_path):
     assert not missing and len(loaded) == 3
 
 
+def test_load_history_restores_types(tmp_path):
+    """csv.DictReader returns all-string rows; load_history must coerce
+    them back or prune_by_history's numeric comparison on loaded history
+    raises TypeError (float vs str)."""
+    from paddle_tpu.distributed.auto_tuner.tuner import prune_by_history
+
+    r = Recorder()
+    r.add_cfg(dp_degree=8, mp_degree=1, pp_degree=1, sharding_degree=1,
+              sharding_stage=1, micro_batch_size=1, use_recompute=True,
+              global_batch_size=8, step_time=0.25, mem_estimate=1.5e9,
+              error=None)
+    r.add_cfg(dp_degree=4, mp_degree=2, pp_degree=1, sharding_degree=1,
+              sharding_stage=1, micro_batch_size=2, use_recompute=False,
+              global_batch_size=8, step_time=None, mem_estimate=3.5e9,
+              error="oom")
+    p = str(tmp_path / "history.csv")
+    r.store_history(p)
+    loaded, missing = r.load_history(p)
+    assert not missing
+    ok = next(h for h in loaded if h["error"] is None)
+    oom = next(h for h in loaded if h["error"] == "oom")
+    assert ok["step_time"] == 0.25 and isinstance(ok["step_time"], float)
+    assert ok["dp_degree"] == 8 and isinstance(ok["dp_degree"], int)
+    assert ok["use_recompute"] is True and oom["use_recompute"] is False
+    assert oom["step_time"] is None  # error=None/"" round-trips to None
+    assert isinstance(oom["mem_estimate"], float)
+    # the regression: history loaded from disk feeds the pruner directly
+    tuner_cfg = {"num_devices": 8, "model_cfg": MODEL_CFG}
+    big = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+           "sharding_degree": 1, "sharding_stage": 1, "micro_batch_size": 4,
+           "use_recompute": False, "global_batch_size": 8}
+    prune_by_history(tuner_cfg, big, loaded)  # must not raise TypeError
+
+
+def test_memory_estimate_matches_placement():
+    """Pin the per-device formulas to the actual DistributedTrainStep
+    placement: body split by mp*pp (+sharding at stage 3), the vocab
+    embedding split by mp only (it lives on ONE pipeline stage; stage 3
+    adds the sharding split on its free dim), optimizer states
+    sharding-split at every stage >= 1."""
+    model = {"hidden_size": 64, "num_layers": 4, "vocab_size": 1024,
+             "seq_length": 32}
+    tuner_cfg = {"model_cfg": model}
+    h, L, vocab, seq = 64, 4, 1024, 32
+    body, emb = 12 * L * h * h, vocab * h
+
+    def cfg(mp, pp, sh, stage, mbs=2, rc=False):
+        return {"dp_degree": 1, "mp_degree": mp, "pp_degree": pp,
+                "sharding_degree": sh, "sharding_stage": stage,
+                "micro_batch_size": mbs, "use_recompute": rc,
+                "global_batch_size": 8}
+
+    # stage 1, mp=2 pp=2 sh=2: emb NOT divided by pp, states /sh
+    got = estimate_memory_bytes(tuner_cfg, cfg(2, 2, 2, 1))
+    want = (2 * (body / 4 + emb / 2)          # bf16 params
+            + 12 * (body / 4 + emb / 2) / 2   # f32 master+moments, ZeRO-1
+            + 2 * seq * h * 16 * (L // 2) / 2)  # activations
+    assert got == want
+    # stage 3, mp=2 pp=1 sh=2: params AND states take the fsdp split;
+    # the embedding is divided by mp and sharding, never by pp
+    got3 = estimate_memory_bytes(tuner_cfg, cfg(2, 1, 2, 3))
+    want3 = (14 * (body / 4 + emb / 4)
+             + 2 * seq * h * 16 * L / 2)
+    assert got3 == want3
+    # more pp must not shrink the embedding term: pp=4 halves the body
+    # vs pp=2 but the owning stage still holds vocab*h/mp
+    e2 = estimate_memory_bytes(tuner_cfg, cfg(1, 2, 1, 1, rc=True))
+    e4 = estimate_memory_bytes(tuner_cfg, cfg(1, 4, 1, 1, rc=True))
+    assert (e2 - e4) == (2 + 12) * (body / 2 - body / 4)
+
+
+def test_tune_records_pruned_and_restores_caller_mesh():
+    """tune() must (a) leave the caller's global mesh exactly as it found
+    it — even when model_builder raises mid-trial — and (b) surface the
+    pruned configs + reasons in the Recorder history so shortlist reports
+    show why configs were skipped."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import env as _env
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    cfg_model = GPTConfig(vocab_size=MODEL_CFG["vocab_size"],
+                          hidden_size=MODEL_CFG["hidden_size"],
+                          num_layers=1, num_heads=4,
+                          max_position_embeddings=64)
+    crit = GPTPretrainingCriterion(cfg_model)
+    tuner_cfg = {
+        "num_devices": 4,
+        "global_batch_size": 8,
+        "model_cfg": dict(MODEL_CFG, num_layers=1),
+        # pp=2 does not divide num_layers=1 -> pruned with a reason
+        "mp_degree": [1], "pp_degree": [1, 2], "sharding_degree": [1],
+        "dp_degree": [2, 4], "micro_batch_size": [1, 2],
+    }
+    calls = {"n": 0}
+
+    def flaky_builder(c):
+        calls["n"] += 1
+        if calls["n"] == 1:  # first trial dies inside model_builder
+            raise RuntimeError("injected model_builder failure")
+        return GPTForCausalLM(cfg_model)
+
+    prior = dist.build_mesh(dp=2, sharding=2,
+                            devices=__import__("jax").devices()[:4])
+    try:
+        best, rec = tune(
+            flaky_builder, lambda lg, lb: crit(lg, lb),
+            lambda m: opt.AdamW(learning_rate=1e-3,
+                                parameters=m.parameters()),
+            tuner_cfg, devices=__import__("jax").devices()[:4], steps=1)
+        assert _env.get_global_mesh() is prior, \
+            "tune() must restore the caller's global mesh"
+        failed = [h for h in rec.history if h.get("error")]
+        assert failed and failed[0]["error"] == "RuntimeError"
+        assert best is not None and best.get("step_time")
+        pruned = [h for h in rec.history if h.get("pruned")]
+        assert pruned and any("pp 2 does not divide" in h["pruned"]
+                              for h in pruned)
+    finally:
+        _env.set_global_mesh(None)
+
+
 def test_tune_measures_and_picks_best():
     """End-to-end sweep on the 8-device CPU mesh over a restricted grid —
     each trial builds a real DistributedTrainStep (reference: subprocess

@@ -1,0 +1,184 @@
+"""Predicted-vs-measured tables for the mesh planner (docs/PLANNER.md).
+
+Reads a Recorder history CSV written by `plan_and_tune` / `tune` —
+`store_history()` rows carrying `predicted_step_time`, `step_time`,
+`prediction_error_pct`, `pruned` — and/or a MeshPlan JSON artifact, and
+prints:
+
+* the per-trial table (predicted vs measured, signed error %),
+* the ranking agreement: the measured top-1's analytic rank and whether it
+  sits inside the analytic top-K (the planner's falsifiability check),
+* pruned/skipped configs with their reasons,
+* the plan artifact's mesh + cost breakdown when --plan is given.
+
+Usage:
+    python -m tools.plan_report history.csv [--plan mesh_plan.json]
+                                            [--top-k 5] [--json]
+
+Exit codes: 0 report printed, 2 unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _mesh_key(row):
+    return (f"dp{row.get('dp_degree')}xpp{row.get('pp_degree')}"
+            f"xsharding{row.get('sharding_degree')}xmp{row.get('mp_degree')}"
+            f"/mbs{row.get('micro_batch_size')}"
+            f"{'+rc' if row.get('use_recompute') else ''}")
+
+
+def build_report(history, top_k=5):
+    """Pure core (tests drive this): history rows -> report dict."""
+    measured = [h for h in history
+                if h.get("step_time") and not h.get("error")]
+    errored = [h for h in history if h.get("error")]
+    pruned = [h for h in history if h.get("pruned")]
+    trials = []
+    for h in sorted(measured, key=lambda r: r["step_time"]):
+        t = {
+            "mesh": _mesh_key(h),
+            "measured_s": round(float(h["step_time"]), 6),
+            "predicted_s": (None if h.get("predicted_step_time") is None
+                            else round(float(h["predicted_step_time"]), 6)),
+            "error_pct": h.get("prediction_error_pct"),
+        }
+        if (t["error_pct"] is None and t["predicted_s"] is not None
+                and t["measured_s"]):
+            t["error_pct"] = round(
+                (t["predicted_s"] - t["measured_s"]) / t["measured_s"] * 100,
+                2)
+        trials.append(t)
+    report = {
+        "measured_trials": len(measured),
+        "errored_trials": len(errored),
+        "pruned_configs": len(pruned),
+        "trials": trials,
+        "pruned": [{"mesh": _mesh_key(h), "reason": h["pruned"]}
+                   for h in pruned],
+        "errors": [{"mesh": _mesh_key(h), "error": h["error"]}
+                   for h in errored],
+    }
+    with_pred = [t for t in trials if t["predicted_s"] is not None]
+    if with_pred:
+        errs = [abs(t["error_pct"]) for t in with_pred
+                if t["error_pct"] is not None]
+        # the analytic ordering must cover the WHOLE ranked grid, not just
+        # the measured shortlist — plan_and_tune records the rejected
+        # candidates' predictions in their pruned rows, and without them
+        # the measured best could never rank outside the top-K (the check
+        # would be unfalsifiable, the one thing it must not be)
+        all_pred = {}
+        for h in history:
+            if h.get("predicted_step_time") is not None:
+                all_pred.setdefault(_mesh_key(h),
+                                    float(h["predicted_step_time"]))
+        analytic_rank = {m: i + 1 for i, (m, _p) in enumerate(
+            sorted(all_pred.items(), key=lambda kv: kv[1]))}
+        best = trials[0]  # sorted by measured time
+        rank = analytic_rank.get(best["mesh"])
+        report["calibration"] = {
+            "mean_abs_error_pct": round(sum(errs) / len(errs), 2)
+            if errs else None,
+            "max_abs_error_pct": round(max(errs), 2) if errs else None,
+            "measured_best": best["mesh"],
+            "measured_best_analytic_rank": rank,
+            "top_k": top_k,
+            "measured_best_in_analytic_top_k": (rank is not None
+                                                and rank <= top_k),
+        }
+    return report
+
+
+def _print_human(report, plan=None):
+    print(f"measured trials: {report['measured_trials']}   "
+          f"errored: {report['errored_trials']}   "
+          f"pruned: {report['pruned_configs']}")
+    if report["trials"]:
+        w = max(len(t["mesh"]) for t in report["trials"]) + 2
+        print(f"\n{'mesh'.ljust(w)}{'measured_s':>12}{'predicted_s':>13}"
+              f"{'error_%':>9}")
+        for t in report["trials"]:
+            pred = "-" if t["predicted_s"] is None else f"{t['predicted_s']:.6f}"
+            err = "-" if t["error_pct"] is None else f"{t['error_pct']:+.1f}"
+            print(f"{t['mesh'].ljust(w)}{t['measured_s']:>12.6f}"
+                  f"{pred:>13}{err:>9}")
+    cal = report.get("calibration")
+    if cal:
+        hit = "IN" if cal["measured_best_in_analytic_top_k"] else "OUTSIDE"
+        print(f"\nmeasured best {cal['measured_best']} is analytic rank "
+              f"#{cal['measured_best_analytic_rank']} — {hit} the "
+              f"analytic top-{cal['top_k']}")
+        if cal["mean_abs_error_pct"] is not None:
+            print(f"prediction error: mean |{cal['mean_abs_error_pct']}|% "
+                  f"max |{cal['max_abs_error_pct']}|%")
+    if report["errors"]:
+        print("\nerrored trials:")
+        for e in report["errors"]:
+            print(f"  {e['mesh']}: {e['error']}")
+    if report["pruned"]:
+        print("\npruned (never measured):")
+        for p in report["pruned"]:
+            print(f"  {p['mesh']}: {p['reason']}")
+    if plan is not None:
+        print(f"\nplan artifact: {plan.describe()}")
+        cost = plan.cost
+        print(f"  compute {cost.get('compute_s')}s + bubble "
+              f"{cost.get('bubble_s')}s + exposed comm "
+              f"{cost.get('exposed_comm_s')}s "
+              f"(overlap {cost.get('overlap_fraction')} from "
+              f"{cost.get('overlap_source')})")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.plan_report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("history", nargs="?",
+                    help="Recorder history CSV (store_history output)")
+    ap.add_argument("--plan", help="MeshPlan JSON artifact to summarize")
+    ap.add_argument("--top-k", type=int, default=5)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    if not args.history and not args.plan:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    history = []
+    if args.history:
+        from paddle_tpu.distributed.auto_tuner import Recorder
+
+        history, missing = Recorder().load_history(args.history)
+        if missing:
+            print(f"plan_report: {args.history} not found", file=sys.stderr)
+            return 2
+    plan = None
+    if args.plan:
+        from paddle_tpu.distributed.planner import MeshPlan
+
+        try:
+            plan = MeshPlan.load(args.plan)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"plan_report: cannot read {args.plan}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
+    report = build_report(history, top_k=args.top_k)
+    if plan is not None:
+        report["plan"] = plan.to_dict()
+    if args.as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        _print_human(report, plan)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
