@@ -485,6 +485,105 @@ def run_resnet_rung(on_tpu):
                  extra={"images_per_sec": round(batch / dt, 1), **tl_info})
 
 
+def run_moe_rung(on_tpu, metrics_path=None):
+    """Expert-parallel MoE train step (BASELINE.md 'gpt3_moe' row;
+    ISSUE-14): decoder embedding + L pre-norm MoE-FFN residual blocks
+    (8 experts, GShard top-2) + tied-size LM head — attention-free, so the
+    measured fast-vs-einsum delta is the MoE dispatch/GEMM path itself,
+    not attention noise. Experts shard over the `ep` mesh axis (as many
+    devices as divide the expert count); the batch shards over ep too, so
+    the dispatch/combine reshards are REAL all-to-all traffic.
+
+    A/B knobs (the recorded bench delta, not a claim): PADDLE_TPU_MOE_FAST
+    =0 runs the dense einsum oracle, PADDLE_TPU_MOE_A2A_CHUNKS sets the
+    a2a chunk schedule. The perf line carries fast=/a2a_chunks=/ep= and,
+    over the timed loop, the collective_bytes_total{op="all_to_all"} delta
+    (all_to_all_bytes=) next to overlap_fraction under --emit-metrics.
+    On CPU the sorted fast path runs its batched-einsum grouped-GEMM
+    fallback (the Pallas kernel needs tpu/axon or interpret mode, which
+    tier-1 kernel tests cover)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.incubate.distributed.models.moe import (ExpertFFN,
+                                                            MoELayer,
+                                                            moe_a2a_chunks,
+                                                            moe_fast_on)
+    from paddle_tpu.observability.metrics import default_registry
+
+    E, topk = 8, 2
+    if on_tpu:
+        M, H, L, V = 1024, 4096, 4, 32000
+        batch, seq, steps = 8, 1024, 10
+    else:
+        M, H, L, V = 64, 128, 2, 1024
+        batch, seq, steps = 8, 128, 3
+    ndev = len(jax.devices())
+    ep = next((c for c in (8, 4, 2) if E % c == 0 and ndev >= c
+               and batch % c == 0), 1)
+    ep_axis = "ep" if ep > 1 else None
+    paddle.seed(0)
+    mesh = dist.build_mesh(ep=ep, devices=jax.devices()[:ep])
+
+    class MoEDecoder(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(V, M)
+            self.norms = nn.LayerList([nn.LayerNorm(M) for _ in range(L)])
+            self.moes = nn.LayerList([
+                MoELayer(M, ExpertFFN(E, M, H, ep_axis=ep_axis),
+                         gate={"type": "gshard", "top_k": topk},
+                         ep_axis=ep_axis)
+                for _ in range(L)])
+            self.head = nn.Linear(M, V)
+
+        def forward(self, ids):
+            x = self.embed(ids)
+            for norm, moe in zip(self.norms, self.moes):
+                x = x + moe(norm(x))
+            return self.head(x)
+
+    model = MoEDecoder()
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = dist.DistributedTrainStep(
+        model, lambda lg, lb: F.cross_entropy(
+            lg.reshape([-1, V]), lb.reshape([-1, 1])), optimizer, mesh=mesh,
+        batch_axes=("dp", "ep"),
+        amp_level="O2" if on_tpu else None, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, V, (batch, seq)))
+    labels = paddle.to_tensor(rng.integers(0, V, (batch, seq)))
+    for _ in range(4):  # compile + the settle warmups _timed_steps would run
+        last = step(ids, labels)
+    _ = float(last)
+    # snapshot AFTER warmup so the a2a byte delta covers exactly the timed
+    # steps the dt covers (every executed step re-emits its volume)
+    reg = default_registry()
+    base = reg.snapshot()
+    dt, tl_info = _timed_steps(lambda: step(ids, labels), steps,
+                               rung="gpt3_moe", warmup=0)
+    a2a_bytes = reg.delta(base).get("collective_bytes_total{op=all_to_all}", 0)
+    if metrics_path:
+        # the counter registry next to the step-timeline records, like the
+        # serving rung — a standalone gpt3_moe run leaves the a2a series on
+        # disk, not only in the perf line
+        reg.export_jsonl(metrics_path)
+    tokens = batch * seq
+    cap = int(np.ceil(1.2 * tokens / E))
+    routed = min(topk * tokens, E * cap)
+    # fwd FLOPs: expert GEMMs over ROUTED rows (the fast-path work model;
+    # the einsum oracle burns strictly more) + router + LM head; *3 fwd+bwd
+    fwd = (L * routed * 4.0 * M * H + L * tokens * 2.0 * M * E
+           + tokens * 2.0 * M * V)
+    return _emit(
+        f"gpt3_moe_e{E}top{topk}_bs{batch}x{seq}", dt, 3.0 * fwd, tokens,
+        extra={"fast": moe_fast_on(), "a2a_chunks": moe_a2a_chunks(),
+               "ep": ep, "experts": E, "top_k": topk,
+               "all_to_all_bytes": int(a2a_bytes), **tl_info})
+
+
 def run_serving_rung(on_tpu, metrics_path=None):
     """Paged-KV serving throughput at a fixed p99 token-latency SLO
     (docs/SERVING.md; BASELINE.md 'inference' row). Drives the
@@ -721,6 +820,7 @@ def main():
                 ("bert", run_bert_rung),
                 ("resnet", run_resnet_rung),
                 ("unet", run_unet_rung),
+                ("moe", lambda t: run_moe_rung(t, metrics_path)),
                 ("serving", lambda t: run_serving_rung(t, metrics_path))):
             try:
                 results.append(rung(on_tpu))
@@ -746,6 +846,8 @@ def main():
         run_resnet_rung(on_tpu)
     elif cfg_name == "unet_sd":
         run_unet_rung(on_tpu)
+    elif cfg_name == "gpt3_moe":
+        run_moe_rung(on_tpu, metrics_path)
     elif cfg_name == "serving":
         run_serving_rung(on_tpu, metrics_path)
     else:

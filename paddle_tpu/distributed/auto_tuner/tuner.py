@@ -28,29 +28,36 @@ def _divisors(n):
 
 def default_candidates(tuner_cfg):
     """Grid of mesh shapes for `num_devices` (reference utils.py
-    default_candidates): every (dp, mp, pp, sharding) factorization plus
-    micro-batch and recompute choices."""
+    default_candidates): every (dp, mp, pp, sharding[, ep]) factorization
+    plus micro-batch and recompute choices. The expert-parallel axis only
+    enters the grid when the model declares experts
+    (model_cfg["moe_num_experts"], or an explicit tuner_cfg["ep_degree"]
+    candidate list) — dense models keep the exact pre-ep grid."""
     ndev = tuner_cfg["num_devices"]
     gbs = tuner_cfg.get("global_batch_size", 8)
+    has_moe = tuner_cfg.get("model_cfg", {}).get("moe_num_experts", 0) > 1
+    eps = tuner_cfg.get("ep_degree", _divisors(ndev) if has_moe else [1])
     cands = []
     for mp in tuner_cfg.get("mp_degree", _divisors(ndev)):
         for pp in tuner_cfg.get("pp_degree", _divisors(ndev)):
             for sharding in tuner_cfg.get("sharding_degree", _divisors(ndev)):
-                if ndev % (mp * pp * sharding):
-                    continue
-                dp = ndev // (mp * pp * sharding)
-                if dp not in tuner_cfg.get("dp_degree", _divisors(ndev)):
-                    continue
-                for mbs in tuner_cfg.get("micro_batch_size", [1, 2, 4]):
-                    for rc in tuner_cfg.get("use_recompute", [True]):
-                        cands.append({
-                            "dp_degree": dp, "mp_degree": mp,
-                            "pp_degree": pp, "sharding_degree": sharding,
-                            "sharding_stage": tuner_cfg.get("sharding_stage", 1),
-                            "micro_batch_size": mbs,
-                            "use_recompute": rc,
-                            "global_batch_size": gbs,
-                        })
+                for ep in eps:
+                    if ndev % (mp * pp * sharding * ep):
+                        continue
+                    dp = ndev // (mp * pp * sharding * ep)
+                    if dp not in tuner_cfg.get("dp_degree", _divisors(ndev)):
+                        continue
+                    for mbs in tuner_cfg.get("micro_batch_size", [1, 2, 4]):
+                        for rc in tuner_cfg.get("use_recompute", [True]):
+                            cands.append({
+                                "dp_degree": dp, "mp_degree": mp,
+                                "pp_degree": pp, "sharding_degree": sharding,
+                                "ep_degree": ep,
+                                "sharding_stage": tuner_cfg.get("sharding_stage", 1),
+                                "micro_batch_size": mbs,
+                                "use_recompute": rc,
+                                "global_batch_size": gbs,
+                            })
     return cands
 
 
@@ -81,6 +88,21 @@ def prune_by_pp(tuner_cfg, cfg, history=None):
         cfg["dp_degree"] * cfg["sharding_degree"] * cfg["micro_batch_size"])
     if pp > 1 and n_micro < pp:
         return f"{n_micro} microbatches < pp {pp}"
+    return None
+
+
+def prune_by_ep(tuner_cfg, cfg, history=None):
+    """ep must divide the expert count (expert-stacked weights shard on
+    `ep`, planner/layout.py expert_stacked), and a dense model has no ep
+    axis to use at all."""
+    ep = cfg.get("ep_degree", 1)
+    if ep <= 1:
+        return None
+    experts = tuner_cfg.get("model_cfg", {}).get("moe_num_experts", 0)
+    if experts <= 1:
+        return f"ep {ep} on a dense model (no moe_num_experts)"
+    if experts % ep:
+        return f"ep {ep} does not divide moe_num_experts {experts}"
     return None
 
 
@@ -168,8 +190,8 @@ def prune_by_history(tuner_cfg, cfg, history):
     return None
 
 
-_PRUNES = [prune_by_mp, prune_by_pp, prune_by_mbs, prune_by_memory,
-           prune_by_history]
+_PRUNES = [prune_by_mp, prune_by_pp, prune_by_ep, prune_by_mbs,
+           prune_by_memory, prune_by_history]
 
 
 # --------------------------------------------------------------------------- #
@@ -325,7 +347,7 @@ def tune(model_builder, loss_fn, optimizer_builder, tuner_cfg, devices=None,
                 mesh = _env.build_mesh(
                     dp=cfg["dp_degree"], pp=cfg["pp_degree"],
                     sharding=cfg["sharding_degree"], mp=cfg["mp_degree"],
-                    devices=devices)
+                    ep=cfg.get("ep_degree", 1), devices=devices)
                 model = model_builder(cfg)
                 optimizer = optimizer_builder(model)
                 step = DistributedTrainStep(

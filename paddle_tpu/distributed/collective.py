@@ -46,6 +46,7 @@ __all__ = [
     "isend",
     "irecv",
     "barrier",
+    "record_collective_traffic",
     "ReduceOp",
     "P2POp",
     "batch_isend_irecv",
@@ -69,7 +70,10 @@ class ReduceOp:
 _obs_handles = None  # lazy HandleCache (metrics imported on first use)
 
 
-def _record_collective(op: str, *tensors):
+def record_collective_traffic(op: str, nbytes: int, calls: int = 1):
+    """Bump collective_{calls,bytes}_total{op=} directly — the byte-count
+    form for callers that know the volume without holding the tensors
+    (the MoE compiled-path a2a accounting, distributed/moe_comm.py)."""
     global _obs_handles
     if _obs_handles is None:
         from ..observability.metrics import HandleCache
@@ -80,16 +84,24 @@ def _record_collective(op: str, *tensors):
             reg.counter("collective_bytes_total",
                         "payload bytes through eager collectives", ("op",)),
         ))
-    calls, bytes_ = _obs_handles.get()
+    calls_, bytes_ = _obs_handles.get()
+    calls_.inc(calls, op=op)
+    if nbytes:
+        bytes_.inc(int(nbytes), op=op)
+
+
+def _tensor_bytes(*tensors):
     nbytes = 0
     for t in tensors:
         v = getattr(t, "_value", t)
         shape = getattr(v, "shape", None)
         if shape is not None:
             nbytes += int(np.prod(shape)) * np.dtype(v.dtype).itemsize
-    calls.inc(1, op=op)
-    if nbytes:
-        bytes_.inc(nbytes, op=op)
+    return nbytes
+
+
+def _record_collective(op: str, *tensors):
+    record_collective_traffic(op, _tensor_bytes(*tensors))
 
 
 _groups: dict[int, "Group"] = {}
@@ -455,8 +467,13 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
-    """rank i sends in[j] to rank j: transpose of the (src, dst) grid."""
-    _record_collective("alltoall", *in_tensor_list)
+    """rank i sends in[j] to rank j: transpose of the (src, dst) grid.
+    Counted under the canonical op="all_to_all" label (shared with
+    alltoall_single and the MoE global_scatter/gather paths — which add
+    the kind="a2a" comm_task intervals at THEIR level, so per-desc
+    exposure reports never double-attribute the same wall time to a
+    nested pair; ISSUE-14 satellite)."""
+    _record_collective("all_to_all", *in_tensor_list)
     import jax.numpy as jnp
 
     g = _grp(group)
@@ -489,8 +506,11 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     """Single-tensor all-to-all. Stacked global view [src, dst_chunks...]:
     rank i's row is the concat of chunks for each destination, so the global
     transform is the (src, dst) chunk-grid transpose — identical to what
-    lax.all_to_all compiles to over a mesh axis."""
-    _record_collective("alltoall_single", in_tensor)
+    lax.all_to_all compiles to over a mesh axis. Counted as
+    op="all_to_all"; interval attribution lives with the MoE-level
+    wrappers (moe_utils global_scatter/gather) so nested calls never
+    double-report the same wall time."""
+    _record_collective("all_to_all", in_tensor)
     import jax.numpy as jnp
 
     g = _grp(group)

@@ -22,7 +22,8 @@ import time
 
 from ..framework import native
 
-__all__ = ["enable", "disable", "comm_task", "drain_report", "peek_report",
+__all__ = ["enable", "disable", "comm_task", "record_task", "drain_report",
+           "peek_report",
            "report_events", "timeout_count", "inflight", "add_task_observer",
            "remove_task_observer"]
 
@@ -54,6 +55,21 @@ _task_observers: list = []
 def add_task_observer(fn):
     _task_observers.append(fn)
     return fn
+
+
+def record_task(desc: str, t0_ns: int, t1_ns: int, kind: str = "comm"):
+    """Feed one already-timed (or estimated — MoE compiled-path a2a,
+    distributed/moe_comm.py) interval to the task observers without
+    entering a tracked region: the timeline-stitching side of comm_task
+    for callers whose interval boundaries the host cannot wrap."""
+    for fn in list(_task_observers):
+        try:
+            fn(desc, int(t0_ns), int(t1_ns), kind)
+        except Exception as e:  # noqa: BLE001
+            import sys
+
+            print(f"[comm_watchdog] task observer failed: {e!r}",
+                  file=sys.stderr)
 
 
 def remove_task_observer(fn):
@@ -201,19 +217,11 @@ def comm_task(desc: str, timeout_seconds=None, kind: str = "comm"):
                 if _wd is wd:
                     lib.watchdog_complete(h, tid)
         # t0 None: no observer was registered at entry — an observer added
-        # mid-region must not receive a garbage interval
+        # mid-region must not receive a garbage interval. record_task's
+        # per-observer error isolation also keeps an observer failure from
+        # masking the region's own exception (we are in a finally block).
         if _task_observers and t0 is not None:
-            t1 = time.perf_counter_ns()
-            for fn in list(_task_observers):
-                try:
-                    fn(desc, t0, t1, kind)
-                except Exception as e:  # noqa: BLE001
-                    # an observer failure must not mask the region's own
-                    # exception (we are in a finally block)
-                    import sys
-
-                    print(f"[comm_watchdog] task observer failed: {e!r}",
-                          file=sys.stderr)
+            record_task(desc, t0, time.perf_counter_ns(), kind)
 
 
 def drain_report() -> str:

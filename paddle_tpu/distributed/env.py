@@ -19,7 +19,7 @@ import numpy as np
 
 _state = threading.local()
 
-AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "ep", "mp")
 
 
 def _jax():
@@ -125,25 +125,28 @@ def get_global_mesh():
     return _global_mesh
 
 
-def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None):
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, ep=1, devices=None):
     """Build the hybrid mesh with named axes in reference topology order.
 
     Axis placement on hardware: trailing axes change fastest over the device
     list, so mp (highest-bandwidth collectives) lands on neighbouring chips —
     the same locality rule the reference uses when carving NCCL rings from
-    the rank grid.
+    the rank grid. `ep` (expert parallelism — the MoE dispatch/combine
+    all-to-all axis, ISSUE-14) sits just outside mp for the same reason:
+    a2a volume per token beats everything but mp's per-layer all-reduces.
     """
     jax = _jax()
     if devices is None:
         devices = np.array(jax.devices())
     else:
         devices = np.array(devices)
-    total = dp * pp * sharding * sep * mp
+    total = dp * pp * sharding * sep * ep * mp
     if total > devices.size:
         raise ValueError(
-            f"mesh {dp}x{pp}x{sharding}x{sep}x{mp}={total} exceeds {devices.size} devices"
+            f"mesh {dp}x{pp}x{sharding}x{sep}x{ep}x{mp}={total} exceeds "
+            f"{devices.size} devices"
         )
-    devices = devices[:total].reshape(dp, pp, sharding, sep, mp)
+    devices = devices[:total].reshape(dp, pp, sharding, sep, ep, mp)
     from jax.sharding import Mesh
 
     mesh = Mesh(devices, AXIS_ORDER)
@@ -182,21 +185,39 @@ def constrain_array(a, spec):
     mesh = get_global_mesh()
     if mesh is None:
         return a
+
+    def strip(entry, manual):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(e for e in entry if e not in manual)
+            return kept if kept else None
+        return None if entry in manual else entry
+
     try:
-        ctx = jax.sharding.get_abstract_mesh()
-        if ctx is not None and not ctx.empty and ctx.manual_axes:
+        # older jaxlibs (0.4.x) have no get_abstract_mesh — probing it with
+        # a bare attribute access used to throw into the broad except below
+        # and silently skip EVERY constraint (MoE ep layouts, TP hints) as
+        # a no-op warning. Probe with getattr and fall through to the plain
+        # global-mesh constraint instead.
+        get_ctx = getattr(jax.sharding, "get_abstract_mesh", None)
+        ctx = get_ctx() if get_ctx is not None else None
+        if (ctx is not None and not ctx.empty
+                and getattr(ctx, "manual_axes", None)):
             manual = set(ctx.manual_axes)
-
-            def strip(entry):
-                if entry is None:
-                    return None
-                if isinstance(entry, tuple):
-                    kept = tuple(e for e in entry if e not in manual)
-                    return kept if kept else None
-                return None if entry in manual else entry
-
-            spec = P(*[strip(s) for s in spec])
+            spec = P(*[strip(s, manual) for s in spec])
             return jax.lax.with_sharding_constraint(a, NamedSharding(ctx, spec))
+        if ctx is None:
+            # 0.4.x manual-context detection: shard_map binds its mesh axes
+            # in the axis env; naming a bound axis in a constraint spec
+            # fails at lowering ("also found in manual_axes"), so strip
+            # every bound axis (conservative — auto axes are bound too on
+            # 0.4.x, losing only a hint, never correctness)
+            from jax._src import core as _jcore  # pragma: no cover - version path
+
+            bound = getattr(_jcore.get_axis_env(), "axis_sizes", None)
+            if bound:
+                spec = P(*[strip(s, set(bound)) for s in spec])
         return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
     except Exception as e:  # pragma: no cover - diagnostic path
         warnings.warn(f"sharding constraint {spec} skipped: {e}")

@@ -158,7 +158,7 @@ class CostModel:
 
     def __init__(self, device=None, peak_flops=None, hbm_bandwidth=None,
                  ici_bandwidth=None, mfu=0.4, alpha=5e-6,
-                 overlap_fraction=None, overlap_paths=None):
+                 overlap_fraction=None, overlap_paths=None, a2a_chunks=None):
         peak, hbm, ici, kind = chip_specs(device)
         self.peak_flops = peak_flops or peak
         self.hbm_bandwidth = hbm_bandwidth or hbm
@@ -166,6 +166,18 @@ class CostModel:
         self.chip = kind
         self.mfu = mfu
         self.alpha = alpha
+        # MoE dispatch/combine chunking: more chunks = more a2a launches
+        # (the alpha/latency term) buying overlap; the byte volume is
+        # chunk-invariant. None resolves the SAME env knob the runtime
+        # schedule honors (PADDLE_TPU_MOE_A2A_CHUNKS, default 2, clamp
+        # [1, 8]) so predictions cost the schedule that will actually run.
+        if a2a_chunks is None:
+            try:
+                a2a_chunks = int(
+                    os.environ.get("PADDLE_TPU_MOE_A2A_CHUNKS", "2"))
+            except ValueError:
+                a2a_chunks = 2
+        self.a2a_chunks = max(1, min(int(a2a_chunks), 8))
         if overlap_fraction is not None:
             self.overlap_fraction = float(overlap_fraction)
             self.overlap_source = "explicit"
@@ -190,11 +202,12 @@ class CostModel:
         seq = model.get("seq_length", 1024)
         dp, mp = cfg["dp_degree"], cfg["mp_degree"]
         pp, sh = cfg["pp_degree"], cfg["sharding_degree"]
+        ep = cfg.get("ep_degree", 1)
         stage = cfg.get("sharding_stage", 1) if sh > 1 else 0
         mbs = cfg["micro_batch_size"]
         gbs = cfg.get("global_batch_size",
                       tuner_cfg.get("global_batch_size", 8))
-        ndev = dp * mp * pp * sh
+        ndev = dp * mp * pp * sh * ep
         n_micro = max(gbs // max(dp * sh * mbs, 1), 1)
 
         # -- compute roofline ------------------------------------------- #
@@ -242,6 +255,20 @@ class CostModel:
         if pp > 1:
             comm_bytes["pp_p2p"] = 2.0 * n_micro * act_block
             comm_count["pp_p2p"] = 2 * n_micro
+        if ep > 1:
+            # MoE dispatch + combine all-to-alls (ISSUE-14): per MoE layer
+            # per microbatch, top-k routed copies of the activation block
+            # reshard token->expert and back; a2a moves (ep-1)/ep of the
+            # payload off-chip. The launch count scales with the chunk
+            # schedule (the latency-bound alpha regime — chunking buys
+            # overlap at the price of more launches), the byte volume does
+            # not.
+            topk = model.get("moe_top_k", 2)
+            moe_layers = max(model.get("moe_layers", L), 1)
+            comm_bytes["ep_a2a"] = (2.0 * moe_layers * n_micro * topk
+                                    * act_block * (ep - 1) / ep)
+            comm_count["ep_a2a"] = int(2 * moe_layers * n_micro
+                                       * self.a2a_chunks)
         comm_s_by_axis = {
             k: v / self.ici_bandwidth + self.alpha * comm_count.get(k, 1)
             for k, v in comm_bytes.items()

@@ -48,6 +48,7 @@ class SpecLayout:
     dp_axis: str = "dp"
     pp_axis: str = "pp"
     sharding_axis: str = "sharding"
+    ep_axis: str = "ep"
     mp_axis: str = "mp"
     fsdp: bool = False
     batch_sharded: bool = True  # batch also split over `sharding` (ZeRO dp)
@@ -69,6 +70,14 @@ class SpecLayout:
         from jax.sharding import PartitionSpec as P
 
         return P(self.mp_axis, self.sharding_axis if self.fsdp else None)
+
+    def expert_stacked(self):
+        """[E, ...] expert-stacked MoE weights (ExpertFFN w1/b1/w2/b2):
+        the expert dim shards over `ep` (expert parallelism, ISSUE-14);
+        FSDP takes the next free dim like the TP layouts do."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.ep_axis, self.sharding_axis if self.fsdp else None)
 
     def norm(self):
         """1-D scale/bias: FSDP shards the only dim, else replicated."""
@@ -97,6 +106,7 @@ class SpecLayout:
             "vocab_embedding": self.vocab_embedding(),
             "column_parallel": self.column_parallel(),
             "row_parallel": self.row_parallel(),
+            "expert_stacked": self.expert_stacked(),
             "norm": self.norm(),
             "replicated": self.replicated(),
             "activations": self.activations(),
@@ -132,12 +142,13 @@ class MeshPlan:
         sh = cfg["sharding_degree"]
         stage = cfg.get("sharding_stage", 1) if sh > 1 else 0
         layout = SpecLayout(fsdp=stage >= 3 and sh > 1, batch_sharded=sh > 1)
+        ep = int(cfg.get("ep_degree", 1))
         mesh = {"dp": cfg["dp_degree"], "pp": cfg["pp_degree"],
-                "sharding": sh, "sep": 1, "mp": cfg["mp_degree"]}
+                "sharding": sh, "sep": 1, "ep": ep, "mp": cfg["mp_degree"]}
         return cls(
             mesh=mesh,
             num_devices=int(cfg["dp_degree"] * cfg["pp_degree"]
-                            * sh * cfg["mp_degree"]),
+                            * sh * ep * cfg["mp_degree"]),
             global_batch_size=int(cfg.get("global_batch_size", 8)),
             micro_batch_size=int(cfg["micro_batch_size"]),
             use_recompute=bool(cfg.get("use_recompute", False)),
@@ -158,6 +169,7 @@ class MeshPlan:
             "dp_degree": self.mesh["dp"], "mp_degree": self.mesh["mp"],
             "pp_degree": self.mesh["pp"],
             "sharding_degree": self.mesh["sharding"],
+            "ep_degree": self.mesh.get("ep", 1),
             "sharding_stage": self.sharding_stage or 1,
             "micro_batch_size": self.micro_batch_size,
             "use_recompute": self.use_recompute,
@@ -176,12 +188,14 @@ class MeshPlan:
         return _env.build_mesh(
             dp=self.mesh["dp"], pp=self.mesh["pp"],
             sharding=self.mesh["sharding"], sep=self.mesh.get("sep", 1),
-            mp=self.mesh["mp"], devices=devices)
+            ep=self.mesh.get("ep", 1), mp=self.mesh["mp"], devices=devices)
 
     def describe(self) -> str:
         m = self.mesh
+        ep = m.get("ep", 1)
         return (f"dp{m['dp']}xpp{m['pp']}xsharding{m['sharding']}"
-                f"xmp{m['mp']} stage{self.sharding_stage} "
+                + (f"xep{ep}" if ep > 1 else "")
+                + f"xmp{m['mp']} stage{self.sharding_stage} "
                 f"mbs{self.micro_batch_size} "
                 f"rc={'on' if self.use_recompute else 'off'} "
                 f"predicted {self.predicted_step_time_s:.6f}s "

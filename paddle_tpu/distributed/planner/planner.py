@@ -58,7 +58,8 @@ _pm = _metrics.HandleCache(lambda reg: {
 def _cfg_key(cfg):
     """Identity of a candidate across planner/tuner bookkeeping."""
     return (cfg["dp_degree"], cfg["mp_degree"], cfg["pp_degree"],
-            cfg["sharding_degree"], cfg.get("sharding_stage", 1),
+            cfg["sharding_degree"], cfg.get("ep_degree", 1),
+            cfg.get("sharding_stage", 1),
             cfg["micro_batch_size"], bool(cfg.get("use_recompute")))
 
 
@@ -167,7 +168,8 @@ def plan_and_tune(model_builder, loss_fn, optimizer_builder, tuner_cfg,
     if best is not None:
         plan = MeshPlan.from_candidate(
             {k: best[k] for k in ("dp_degree", "mp_degree", "pp_degree",
-                                  "sharding_degree", "sharding_stage",
+                                  "sharding_degree", "ep_degree",
+                                  "sharding_stage",
                                   "micro_batch_size", "use_recompute",
                                   "global_batch_size") if k in best},
             best_bd if best_bd is not None else cm.predict(tuner_cfg, best),

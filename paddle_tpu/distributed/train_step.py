@@ -138,6 +138,16 @@ class DistributedTrainStep(TrainStep):
         self.comm_overlap = bool(comm_overlap)
         self._host_kind = host_memory_kind(self.mesh)
         self._bucket_plan = None
+        # MoE a2a records registered by THIS step's traces: __call__ marks
+        # the registry before each dispatch, _post_dispatch claims whatever
+        # that call's (re)trace registered — a shape-change retrace
+        # replaces the emitted set instead of leaving it stale, and records
+        # from another model's build can never land in this step's window
+        from . import moe_comm as _moe_comm
+
+        self._moe_a2a = None
+        self._moe_pre = _moe_comm.trace_marker()
+        self._moe_t0 = 0
         if sharding_stage == 3:
             shard_params_for_stage3(model, mesh=self.mesh)
         super().__init__(model, loss_fn, optimizer, **kw)
@@ -278,6 +288,20 @@ class DistributedTrainStep(TrainStep):
 
             with comm_watchdog.comm_task("offload/d2h", kind="comm"):
                 self._move_opt_states(host=True)
+        # MoE expert-parallel a2a accounting: the traced MoE fast path
+        # registered its per-step dispatch/combine all-to-all volume during
+        # this program's trace (moe_comm.note_a2a); any (re)trace inside
+        # THIS call's window replaces the claimed set, and every call
+        # re-emits it as collective_{calls,bytes}_total{op="all_to_all"} +
+        # estimated comm_task(kind="a2a") intervals — anchored inside this
+        # step's compute span (floored at the dispatch start), mirroring
+        # how the chunked schedule overlaps them on device.
+        from . import moe_comm as _moe_comm
+
+        fresh = _moe_comm.drain_since(self._moe_pre)
+        if fresh or self._moe_a2a is None:
+            self._moe_a2a = fresh
+        _moe_comm.emit_step(self._moe_a2a, floor_ns=self._moe_t0)
 
     def _sharding(self, spec, host=False):
         kind = self._host_kind if host else None
@@ -357,6 +381,13 @@ class DistributedTrainStep(TrainStep):
                 _obs_spans.record_span("train_step/prev_step_inflight",
                                        t0, time.perf_counter_ns(),
                                        kind="compute")
+        # a2a-accounting window for this dispatch (see _post_dispatch):
+        # registry mark scopes retraces to this call; the timestamp floors
+        # the estimated intervals inside the step's compute span
+        from . import moe_comm as _moe_comm
+
+        self._moe_pre = _moe_comm.trace_marker()
+        self._moe_t0 = time.perf_counter_ns()
         loss = super().__call__([Tensor(a) for a in placed_in], [Tensor(a) for a in placed_lb])
         self._inflight = loss._value
         if self.offload and not streaming and not self.comm_overlap:

@@ -85,19 +85,33 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
     MAX reduce + one equal-split all-to-all) is identical on every rank
     regardless of how ragged each rank's counts are, so ranks can never
     diverge onto mismatched collectives. Uniform counts are just the
-    cap == count special case."""
+    cap == count special case.
+
+    Timed as a kind="a2a" comm_task interval, and counted under
+    op="all_to_all" in collective_{calls,bytes}_total by the inner
+    alltoall_single (bytes reflect the capacity-padded wire buffer) — the
+    eager MoE dispatch is real measured comm in flight/step records
+    (ISSUE-14 satellite; the compiled fast path registers its volume via
+    distributed/moe_comm.py instead)."""
+    from .. import comm_watchdog
+
     sc = _concrete_counts(local_count)
     rc = _concrete_counts(global_count)
-    return _dispatch_exchange(x, sc, rc, group)
+    with comm_watchdog.comm_task("moe/global_scatter", kind="a2a"):
+        return _dispatch_exchange(x, sc, rc, group)
 
 
 def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
     """Inverse of global_scatter (reference: moe_utils.py global_gather) —
     returns expert outputs to the ranks that own the tokens. Send blocks are
-    counted by `global_count`, receive blocks by `local_count`."""
+    counted by `global_count`, receive blocks by `local_count`. Same
+    kind="a2a" interval + op="all_to_all" counting as global_scatter."""
+    from .. import comm_watchdog
+
     sc = _concrete_counts(local_count)
     rc = _concrete_counts(global_count)
-    return _dispatch_exchange(x, rc, sc, group)
+    with comm_watchdog.comm_task("moe/global_gather", kind="a2a"):
+        return _dispatch_exchange(x, rc, sc, group)
 
 
 def _dispatch_exchange(x, send_counts, recv_counts, group):

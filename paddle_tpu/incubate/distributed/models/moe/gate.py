@@ -26,6 +26,38 @@ from .....framework.core import run_op
 __all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
 
 
+def _topk_route(probs, k, normalize_topk, choice_keep=None):
+    """Raw top-k routing, shared by the dense einsum path and the sorted
+    fast path so the two can never disagree on route choices or the aux
+    loss.
+
+    probs: [S, E] router probabilities. Returns (topi [S,k] int32 expert
+    ids, topv [S,k] combine weights — zeroed for dropped choices, keep
+    [S,k] bool, l_aux). The load-balancing aux loss (GShard eq.4) is
+    computed from the PRE-DROP router stats — raw probs and the raw first
+    choice — never from post-capacity (or post-random-routing) dispatch
+    counts: stats taken after drops are biased TOWARD already-overflowed
+    experts (their overflow is exactly what the drop removed), which
+    inverts the loss's pressure. Pinned by
+    tests/test_moe.py::TestGateAuxLoss."""
+    S, E = probs.shape
+    topv, topi = jax.lax.top_k(probs, k)  # [S, k]
+    if normalize_topk:
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    # aux loss BEFORE any drop logic: E * sum_e mean_prob_e * frac_top1_e
+    me = probs.mean(0)                                       # [E]
+    ce = jax.nn.one_hot(topi[:, 0], E, dtype=probs.dtype).mean(0)
+    l_aux = (me * ce).sum() * E
+
+    if choice_keep is not None:
+        keep = choice_keep
+        topv = topv * keep.astype(topv.dtype)
+    else:
+        keep = jnp.ones(topi.shape, bool)
+    return topi, topv, keep, l_aux
+
+
 def _topk_dispatch(probs, k, capacity, normalize_topk, choice_keep=None):
     """Dense top-k routing with capacity.
 
@@ -36,20 +68,10 @@ def _topk_dispatch(probs, k, capacity, normalize_topk, choice_keep=None):
     individual (token, choice) routes (GShard random routing).
     """
     S, E = probs.shape
-    topv, topi = jax.lax.top_k(probs, k)  # [S, k]
-    if normalize_topk:
-        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
-
-    onehot = jax.nn.one_hot(topi, E, dtype=probs.dtype)  # [S, k, E]
-    if choice_keep is not None:
-        keep_f = choice_keep.astype(probs.dtype)
-        onehot = onehot * keep_f[..., None]
-        topv = topv * keep_f
-
-    # load-balancing aux loss (GShard eq.4): E * sum_e mean_prob_e * frac_top1_e
-    me = probs.mean(0)                                   # [E]
-    ce = onehot[:, 0, :].mean(0)                         # fraction routed (1st choice)
-    l_aux = (me * ce).sum() * E
+    topi, topv, keepc, l_aux = _topk_route(probs, k, normalize_topk,
+                                           choice_keep)
+    onehot = (jax.nn.one_hot(topi, E, dtype=probs.dtype)
+              * keepc.astype(probs.dtype)[..., None])        # [S, k, E]
 
     # choice-major priority: all 1st choices rank before any 2nd choice
     m = jnp.transpose(onehot, (1, 0, 2)).reshape(k * S, E)
@@ -89,12 +111,35 @@ class BaseGate(nn.Layer):
     def l_aux(self):
         return self.loss
 
+    #: combine weights renormalized over the selected top-k (GShard style)
+    _normalize_topk = True
+
     def capacity(self, num_tokens):
         raise NotImplementedError
 
+    def _probs_and_keep(self, xv, w, b):
+        """Pure fn -> (probs [S, E] f32, choice_keep [S, k] bool | None).
+        The ONE place each gate's router math lives — both the dense
+        einsum dispatch and the sorted fast path route through it."""
+        raise NotImplementedError
+
+    def _route(self, xv, w, b):
+        """Raw routing for the sorted fast path: (topi [S,k], topv [S,k]
+        in xv.dtype, keep [S,k] bool, l_aux). No dense [S,E,C] tensors are
+        built — capacity enforcement is the caller's cheap positional drop
+        mask, not one-hot pruning."""
+        probs, keep = self._probs_and_keep(xv, w, b)
+        topi, topv, keepc, l_aux = _topk_route(
+            probs, self.top_k, self._normalize_topk, keep)
+        return topi, topv.astype(xv.dtype), keepc, l_aux
+
     def _routing(self, xv, w, b):
         """Pure fn of raw arrays -> (combine, dispatch, l_aux)."""
-        raise NotImplementedError
+        probs, keep = self._probs_and_keep(xv, w, b)
+        cap = self.capacity(xv.shape[0])
+        c, d, l = _topk_dispatch(probs, self.top_k, cap,
+                                 self._normalize_topk, choice_keep=keep)
+        return c.astype(xv.dtype), d.astype(xv.dtype), l
 
     def forward(self, x):
         out = run_op(self.__class__.__name__.lower(), self._routing,
@@ -116,10 +161,8 @@ class NaiveGate(BaseGate):
     def capacity(self, num_tokens):
         return int(num_tokens)
 
-    def _routing(self, xv, w, b):
-        probs = jax.nn.softmax((xv @ w + b).astype(jnp.float32), axis=-1)
-        c, d, l = _topk_dispatch(probs, self.top_k, xv.shape[0], normalize_topk=True)
-        return c.astype(xv.dtype), d.astype(xv.dtype), l
+    def _probs_and_keep(self, xv, w, b):
+        return jax.nn.softmax((xv @ w + b).astype(jnp.float32), axis=-1), None
 
 
 class GShardGate(BaseGate):
@@ -138,9 +181,8 @@ class GShardGate(BaseGate):
         f = self.capacity_factor[0] if self.training else self.capacity_factor[1]
         return max(1, int(math.ceil(f * num_tokens / self.tot_expert)))
 
-    def _routing(self, xv, w, b):
+    def _probs_and_keep(self, xv, w, b):
         probs = jax.nn.softmax((xv @ w + b).astype(jnp.float32), axis=-1)
-        cap = self.capacity(xv.shape[0])
         choice_keep = None
         if self.random_routing and self.training:
             # GShard §3.2: the 2nd expert fires with probability ∝ its
@@ -150,9 +192,7 @@ class GShardGate(BaseGate):
             keep2 = (2.0 * topv[:, 1]) > u
             choice_keep = jnp.stack(
                 [jnp.ones_like(keep2), keep2], axis=-1)
-        c, d, l = _topk_dispatch(probs, 2, cap, normalize_topk=True,
-                                 choice_keep=choice_keep)
-        return c.astype(xv.dtype), d.astype(xv.dtype), l
+        return probs, choice_keep
 
 
 class SwitchGate(BaseGate):
@@ -171,13 +211,12 @@ class SwitchGate(BaseGate):
         f = self.capacity_factor[0] if self.training else self.capacity_factor[1]
         return max(1, int(math.ceil(f * num_tokens / self.tot_expert)))
 
-    def _routing(self, xv, w, b):
+    _normalize_topk = False
+
+    def _probs_and_keep(self, xv, w, b):
         logits = xv @ w + b
         if self.training and self.switch_eps > 0:
             noise = jax.random.uniform(rnd.next_key(), logits.shape, logits.dtype,
                                        1.0 - self.switch_eps, 1.0 + self.switch_eps)
             logits = logits * noise
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        cap = self.capacity(xv.shape[0])
-        c, d, l = _topk_dispatch(probs, 1, cap, normalize_topk=False)
-        return c.astype(xv.dtype), d.astype(xv.dtype), l
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1), None

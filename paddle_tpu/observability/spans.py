@@ -145,11 +145,20 @@ def _intersect_len(a, b):
     return total
 
 
+# comm_task kinds whose intervals join the comm union of the overlap
+# accounting; any other kind ("step", ...) is deadline tracking only
+COMM_KINDS = ("comm", "a2a")
+
+
 def overlap_stats(comm_tasks, spans) -> dict:
     """Per-step comm/compute overlap from a step record's interval lists.
 
-    comm intervals: `comm_tasks` entries with kind "comm" (deadline-only
-    regions like the trainer's whole-step watchdog tag are excluded).
+    comm intervals: `comm_tasks` entries with a communication kind —
+    "comm", or "a2a" (MoE dispatch/combine all-to-alls, ISSUE-14; eager
+    a2a intervals are measured, compiled-path ones are `[est]`-marked
+    analytic estimates registered via distributed/moe_comm.py).
+    Deadline-only regions like the trainer's whole-step watchdog tag
+    ("step") stay excluded.
     compute intervals: spans explicitly tagged `kind="compute"` — driver
     wrappers (fit/train_batch and friends) span the whole step including
     its comm, so compute attribution is opt-in, not inferred.
@@ -162,7 +171,7 @@ def overlap_stats(comm_tasks, spans) -> dict:
     comm = _merge_intervals(
         (t.get("start_ns", 0) / 1e9,
          t.get("start_ns", 0) / 1e9 + t.get("dur_s", 0.0))
-        for t in comm_tasks if t.get("kind", "comm") == "comm")
+        for t in comm_tasks if t.get("kind", "comm") in COMM_KINDS)
     compute = _merge_intervals(
         (s.get("start_ns", 0) / 1e9,
          s.get("start_ns", 0) / 1e9 + s.get("dur_s", 0.0))

@@ -245,11 +245,16 @@ def test_all_pallas_kernels_consult_tuner(monkeypatch):
     c = jnp.cos(jnp.ones((1, 64, 16), jnp.float32))
     s = jnp.sin(jnp.ones((1, 64, 16), jnp.float32))
     apply_fused_rope((q,), c, s)
+    from paddle_tpu.ops.pallas.grouped_gemm import grouped_matmul
+
+    grouped_matmul(jnp.asarray(rng.standard_normal((16, 8)), jnp.float32),
+                   jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32),
+                   jnp.asarray([8, 4], jnp.int32))
 
     tiles = autotune.chosen_tiles()
     for kernel in ("flash_fwd", "flashmask_fwd", "varlen_fwd",
                    "decode_dense", "decode_paged", "fused_rms_norm",
-                   "fused_layer_norm", "fused_rope"):
+                   "fused_layer_norm", "fused_rope", "grouped_gemm"):
         assert kernel in tiles, (kernel, sorted(tiles))
         assert tiles[kernel]["bq"] > 0 and tiles[kernel]["bk"] > 0
 

@@ -26,7 +26,8 @@ def reset_groups():
 class TestTopology:
     def test_mesh_axes(self):
         mesh = dist.build_mesh(dp=2, mp=4)
-        assert mesh.shape == {"dp": 2, "pp": 1, "sharding": 1, "sep": 1, "mp": 4}
+        assert mesh.shape == {"dp": 2, "pp": 1, "sharding": 1, "sep": 1,
+                              "ep": 1, "mp": 4}
         assert mesh.devices.size == 8
 
     def test_communicate_topology(self):

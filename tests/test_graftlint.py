@@ -227,6 +227,47 @@ class TestGL002:
         """, rules=["GL002"])
         assert len(fs) == 1
 
+    def test_rank_conditional_expert_dispatch(self, tmp_path):
+        """ISSUE-14 fixture: per-rank expert counts gating the MoE
+        all-to-all — the canonical expert-parallel deadlock (a rank with no
+        routed tokens skips the exchange while its peers park in it).
+        The count-shaped guard mentions the rank, so GL002 must fire; the
+        fixed form (exchange unconditionally, counts steer only payload
+        layout) must stay clean."""
+        fs = lint_src(tmp_path, """
+            from paddle_tpu.distributed.utils import global_scatter
+
+            def dispatch(x, local_count, global_count, rank):
+                if local_count[rank] > 0:
+                    return global_scatter(x, local_count, global_count)
+                return x
+        """, rules=["GL002"])
+        assert rule_ids(fs) == ["GL002"]
+        assert "global_scatter" in fs[0].message
+
+        fs = lint_src(tmp_path, """
+            from paddle_tpu.distributed.utils import global_scatter
+
+            def dispatch(x, local_count, global_count, rank):
+                out = global_scatter(x, local_count, global_count)
+                if local_count[rank] == 0:
+                    return x
+                return out
+        """, rules=["GL002"])
+        assert fs == []
+
+    def test_moe_fast_path_files_clean(self):
+        """ISSUE-14 satellite: the new moe/grouped-gemm/a2a-accounting
+        files lint clean with NO new baseline entries (the deadlock-shaped
+        patterns above must never ship in the real dispatch path)."""
+        fs = lint_paths([
+            REPO / "paddle_tpu/incubate/distributed/models/moe",
+            REPO / "paddle_tpu/ops/pallas/grouped_gemm.py",
+            REPO / "paddle_tpu/distributed/moe_comm.py",
+            REPO / "paddle_tpu/distributed/utils/moe_utils.py",
+        ], root=REPO)
+        assert fs == [], "\n".join(f.format() for f in fs)
+
 
 # --------------------------------------------------------------------------- #
 # GL003 swallowed exception

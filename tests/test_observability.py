@@ -622,6 +622,17 @@ class TestOverlapStats:
         assert ov["comm_s"] == pytest.approx(0.1)
         assert ov["fraction"] == 0.0
 
+    def test_a2a_kind_joins_comm_union(self):
+        # MoE all-to-all intervals (kind="a2a", ISSUE-14) are comm for the
+        # overlap accounting; "step" stays excluded beside them
+        comm = [_ct(0.0, 0.2, desc="moe/a2a/epx4[est]", kind="a2a"),
+                _ct(0.1, 0.2),
+                _ct(0.0, 1.0, desc="train_step/1", kind="step")]
+        ov = spans.overlap_stats(comm, [_sp(0.0, 0.15)])
+        assert ov["comm_s"] == pytest.approx(0.3)
+        assert ov["covered_s"] == pytest.approx(0.15)
+        assert "a2a" in spans.COMM_KINDS and "step" not in spans.COMM_KINDS
+
     def test_multi_interval_sweep(self):
         comm = [_ct(0.0, 0.1), _ct(0.2, 0.1), _ct(0.4, 0.1)]
         compute = [_sp(0.05, 0.2), _sp(0.45, 0.2)]

@@ -98,6 +98,49 @@ class TestCostModel:
         assert cm.predict(capped, _cfg(dp=8))["mem_ok"] is False
         assert cm.predict(t, _cfg(dp=8))["mem_ok"] is True
 
+    def test_ep_a2a_term_monotone_and_gated(self):
+        """ISSUE-14: the MoE dispatch/combine a2a volume term. Dense models
+        never see it; under ep it grows with (ep-1)/ep (byte volume) and
+        with the chunk schedule (launch-latency alpha regime)."""
+        cm = CostModel()
+        moe_cfg = _tcfg(model_cfg=dict(MODEL_CFG, moe_num_experts=8,
+                                       moe_top_k=2))
+        dense = cm.predict(_tcfg(), _cfg(dp=8))
+        assert "ep_a2a" not in dense["comm_s_by_axis"]
+        no_ep = cm.predict(moe_cfg, dict(_cfg(dp=8), ep_degree=1))
+        assert "ep_a2a" not in no_ep["comm_s_by_axis"]
+        prev = 0.0
+        for ep in (2, 4, 8):
+            bd = cm.predict(moe_cfg, dict(_cfg(dp=8 // ep), ep_degree=ep))
+            cur = bd["comm_s_by_axis"]["ep_a2a"]
+            assert cur > prev
+            assert bd["comm_bytes_by_axis"]["ep_a2a"] > 0
+            prev = cur
+        # latency-bound regime: more chunks = more launches = more alpha
+        few = CostModel(a2a_chunks=1).predict(
+            moe_cfg, dict(_cfg(dp=2), ep_degree=4))
+        many = CostModel(a2a_chunks=4).predict(
+            moe_cfg, dict(_cfg(dp=2), ep_degree=4))
+        assert many["comm_s_by_axis"]["ep_a2a"] > few["comm_s_by_axis"]["ep_a2a"]
+        assert (many["comm_bytes_by_axis"]["ep_a2a"]
+                == few["comm_bytes_by_axis"]["ep_a2a"])
+
+    def test_ep_grid_gated_on_moe_and_pruned_by_experts(self):
+        """The candidate grid only grows an ep dimension for MoE models,
+        and ep must divide the expert count."""
+        ranked, _pruned = rank_candidates(_tcfg(mp_degree=[1],
+                                                pp_degree=[1],
+                                                sharding_degree=[1]))
+        assert all(cfg.get("ep_degree", 1) == 1 for cfg, _bd in ranked)
+        moe = _tcfg(model_cfg=dict(MODEL_CFG, moe_num_experts=4,
+                                   moe_top_k=2),
+                    mp_degree=[1], pp_degree=[1], sharding_degree=[1])
+        ranked, pruned = rank_candidates(moe)
+        eps = {cfg.get("ep_degree", 1) for cfg, _bd in ranked}
+        assert {1, 2, 4} <= eps and 8 not in eps  # 8 !| 4 experts
+        assert any("moe_num_experts" in r for _c, n, r in pruned
+                   if n == "prune_by_ep")
+
     def test_overlap_discount_from_step_timeline(self, tmp_path):
         """The measured half: overlap_fraction from step-timeline JSONL
         discounts exposed comm; no history means all comm exposed."""
@@ -268,6 +311,39 @@ class TestMeshPlan:
         assert plan.sharding_stage == 3
         assert plan.partition_specs()["column_parallel"] == P("sharding", "mp")
         assert plan.tuner_candidate()["sharding_stage"] == 3
+
+    def test_ep_layout_round_trip(self, tmp_path):
+        """ISSUE-14: an ep>1 candidate round-trips through the MeshPlan
+        artifact — mesh axis, expert_stacked layout, tuner candidate, and
+        the materialized mesh all carry ep."""
+        from jax.sharding import PartitionSpec as P
+
+        cfg = dict(_cfg(dp=2, mp=1), ep_degree=4)
+        moe_cfg = _tcfg(model_cfg=dict(MODEL_CFG, moe_num_experts=8,
+                                       moe_top_k=2))
+        plan = MeshPlan.from_candidate(
+            cfg, CostModel().predict(moe_cfg, cfg),
+            model_cfg=moe_cfg["model_cfg"])
+        assert plan.mesh["ep"] == 4 and plan.num_devices == 8
+        assert plan.partition_specs()["expert_stacked"] == P("ep", None)
+        assert plan.tuner_candidate()["ep_degree"] == 4
+        p = str(tmp_path / "mesh_plan.json")
+        plan.save(p)
+        loaded = MeshPlan.load(p)
+        assert loaded == plan
+        mesh = loaded.build_mesh(devices=jax.devices()[:8])
+        assert dist.env.mesh_shape(mesh) == loaded.mesh
+        assert "xep4" in loaded.describe()
+        dist.env.set_global_mesh(None)
+        # a pre-ep plan file (no "ep" key) still loads and builds
+        d = loaded.to_dict()
+        d["mesh"] = {k: v for k, v in d["mesh"].items() if k != "ep"}
+        d["num_devices"] = 2
+        old = MeshPlan.from_dict(d)
+        assert old.tuner_candidate()["ep_degree"] == 1
+        mesh = old.build_mesh(devices=jax.devices()[:2])
+        assert dist.env.mesh_shape(mesh)["ep"] == 1
+        dist.env.set_global_mesh(None)
 
     def test_infeasible_grid_raises(self):
         # 7 devices, grid that cannot factorize onto heads=4/layers=2

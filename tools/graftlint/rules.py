@@ -356,6 +356,10 @@ _COLLECTIVES = {
     "scatter_object_list", "allreduce_value", "allgather_values",
     "allgather_objects", "broadcast_value", "broadcast_objects",
     "store_allreduce_group", "sync_global_devices",
+    # MoE expert dispatch (distributed/utils/moe_utils.py): every rank must
+    # reach the exchange even when ITS per-rank expert counts are zero —
+    # count-gated calls are the canonical expert-parallel deadlock
+    "global_scatter", "global_gather",
 }
 # Names that are collectives only in dotted form (`dist.reduce(...)`); the
 # bare names collide with builtins/stdlib (functools.reduce, Event.wait).

@@ -25,6 +25,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from paddle_tpu.observability.spans import (  # noqa: E402
+    COMM_KINDS,
     _intersect_len,
     _merge_intervals,
     aggregate_overlap,
@@ -68,7 +69,7 @@ def exposed_by_desc(rec):
         if (s.get("attrs") or {}).get("kind") == "compute")
     out = {}
     for t in rec.get("comm_tasks", []):
-        if t.get("kind", "comm") != "comm":
+        if t.get("kind", "comm") not in COMM_KINDS:
             continue
         s = t.get("start_ns", 0) / 1e9
         iv = [(s, s + t.get("dur_s", 0.0))]
