@@ -18,10 +18,13 @@ rounds can be read next to the per-step telemetry that produced them, not
 just the wall-time headline.
 
 Rungs: gpt3_1p3b gpt3_350m gpt3_125m llama_7bshape bert_base resnet50
-unet_sd serving cpu_smoke. `serving` drives the paged-KV engine
-(docs/SERVING.md) and reports tokens/sec at the p99 token latency it
+unet_sd serving serving_quant cpu_smoke. `serving` drives the paged-KV
+engine (docs/SERVING.md) and reports tokens/sec at the p99 token latency it
 measured, plus TTFT percentiles; with --emit-metrics the serving SLO
 registry series is appended to the JSONL once per scheduler tick.
+`serving_quant` A/Bs the int8-KV + weight-only-int8 fast path against the
+full-precision engine at an equal KV HBM byte budget (tokens/s, p99, peak
+concurrency, kv bytes/token per leg).
 
 `--plan` prints the mesh planner's analytic top-K shortlist + cost
 breakdown for the selected rung config (docs/PLANNER.md) without timing
@@ -584,6 +587,62 @@ def run_moe_rung(on_tpu, metrics_path=None):
                "all_to_all_bytes": int(a2a_bytes), **tl_info})
 
 
+def _serving_workload(cfg, S, n_req):
+    """The serving rungs' shared request mix: every third prompt extends one
+    long common prefix (exercises prefix sharing), lengths staggered, every
+    fourth request sampled at T=0.7 and the rest greedy. One definition so
+    `serving` and `serving_quant` numbers stay comparable — returns
+    [(prompt, temperature), ...]."""
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, S // 4).astype(np.int32)
+    out = []
+    for i in range(n_req):
+        tail = rng.integers(1, cfg.vocab_size,
+                            2 + i % (S // 8)).astype(np.int32)
+        prompt = (np.concatenate([shared, tail]) if i % 3 == 0
+                  else rng.integers(1, cfg.vocab_size,
+                                    4 + i % (S // 4)).astype(np.int32))
+        out.append((prompt, 0.7 if i % 4 == 0 else 0.0))
+    return out
+
+
+def _drain_serving_engine(eng, reg, metrics_path=None, timeline=None,
+                          rung=None):
+    """Drain a serving engine, timing every scheduler tick. Ticks that paid
+    a one-time XLA compile (a prefill bucket or the decode program) are
+    warmup, not steady-state token latency — excluding them keeps p99/slo
+    honest on cold runs; throughput still counts every token and all wall
+    time. One definition shared by the `serving` and `serving_quant` rungs
+    so their latency-exclusion semantics cannot drift apart. With
+    `metrics_path` the registry is appended to the JSONL once per tick."""
+    step_lat, tokens, tick, compile_ticks, peak_live = [], 0, 0, 0, 0
+    t_start = time.perf_counter()
+    while eng.has_work():
+        if timeline is not None:
+            timeline.step_begin(tick)
+        compiles0 = eng._prefill_cache.compiles_total
+        decode_cold = eng._decode_jit is None
+        t0 = time.perf_counter()
+        out = eng.step()
+        dt = time.perf_counter() - t0
+        if timeline is not None:
+            timeline.step_end(extra={"rung": rung})
+        peak_live = max(peak_live, eng.live_count)
+        if out:
+            if (eng._prefill_cache.compiles_total > compiles0
+                    or decode_cold):
+                compile_ticks += 1
+            else:
+                step_lat.append(dt)
+            tokens += len(out)
+        if metrics_path:
+            reg.export_jsonl(metrics_path)
+        tick += 1
+    return {"step_lat": step_lat, "tokens": tokens,
+            "compile_ticks": compile_ticks, "peak_live": peak_live,
+            "total_s": time.perf_counter() - t_start}
+
+
 def run_serving_rung(on_tpu, metrics_path=None):
     """Paged-KV serving throughput at a fixed p99 token-latency SLO
     (docs/SERVING.md; BASELINE.md 'inference' row). Drives the
@@ -613,52 +672,22 @@ def run_serving_rung(on_tpu, metrics_path=None):
         model = GPTForCausalLM(cfg)
         eng = PagedServingEngine(model, max_batch_size=B, max_seq_len=S,
                                  page_size=ps)
-        rng = np.random.default_rng(0)
-        shared = rng.integers(1, cfg.vocab_size, S // 4).astype(np.int32)
-        for i in range(n_req):
-            tail = rng.integers(1, cfg.vocab_size,
-                                2 + i % (S // 8)).astype(np.int32)
-            prompt = (np.concatenate([shared, tail]) if i % 3 == 0
-                      else rng.integers(1, cfg.vocab_size,
-                                        4 + i % (S // 4)).astype(np.int32))
-            eng.add_request(prompt, max_new_tokens=max_new,
-                            temperature=0.7 if i % 4 == 0 else 0.0)
+        for prompt, temp in _serving_workload(cfg, S, n_req):
+            eng.add_request(prompt, max_new_tokens=max_new, temperature=temp)
         reg = default_registry()
         base = reg.snapshot()
-        tl = _obs_spans.active_timeline()
-        step_lat, tokens, tick, compile_ticks = [], 0, 0, 0
-        t_start = time.perf_counter()
-        while eng.has_work():
-            if tl is not None:
-                tl.step_begin(tick)
-            compiles0 = eng._prefill_cache.compiles_total
-            decode_cold = eng._decode_jit is None
-            t0 = time.perf_counter()
-            out = eng.step()
-            dt = time.perf_counter() - t0
-            if tl is not None:
-                tl.step_end(extra={"rung": "serving"})
-            if out:
-                # ticks that paid a one-time XLA compile (a prefill bucket
-                # or the decode program) are warmup, not steady-state token
-                # latency — excluding them keeps p99/slo_met honest on cold
-                # runs; throughput still counts every token and all wall time
-                if (eng._prefill_cache.compiles_total > compiles0
-                        or decode_cold):
-                    compile_ticks += 1
-                else:
-                    step_lat.append(dt)
-                tokens += len(out)
-            if metrics_path:
-                reg.export_jsonl(metrics_path)
-            tick += 1
-        total_s = time.perf_counter() - t_start
+        st = _drain_serving_engine(eng, reg, metrics_path,
+                                   timeline=_obs_spans.active_timeline(),
+                                   rung="serving")
+        total_s, step_lat = st["total_s"], st["step_lat"]
+        compile_ticks = st["compile_ticks"]
         done = eng.finished
         delta = reg.delta(base)
         # step() returns only decode-advance tokens; each request's FIRST
         # token is emitted at admission and never appears in `out`. The
         # registry counter saw every token, so it is the honest numerator.
-        tokens = delta.get("serving_tokens_total{engine=paged}", tokens)
+        tokens = delta.get("serving_tokens_total{engine=paged}",
+                           st["tokens"])
         ttfts = sorted(r._t_first - r._t_arrival for r in done
                        if r._t_first is not None)
         slo_s = float(os.environ.get("SERVING_SLO_MS", "200")) / 1e3
@@ -682,6 +711,93 @@ def run_serving_rung(on_tpu, metrics_path=None):
             "truncations": delta.get("serving_truncations_total"
                                      "{engine=paged}", 0),
             "pages_total": eng.pool.pages_total,
+        }
+        print(json.dumps(line), flush=True)
+        return line
+    finally:
+        if interp_prev is None:
+            os.environ.pop("PADDLE_TPU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = interp_prev
+
+
+def run_serving_quant_rung(on_tpu, metrics_path=None):
+    """Quantized serving A/B at EQUAL KV HBM budget (docs/SERVING.md
+    "Quantized KV cache"; BASELINE.md row). Leg A: the full-precision paged
+    engine. Leg B: `PADDLE_TPU_KV_QUANT=1` + `PADDLE_TPU_SERVE_W8=1` — int8
+    pages with per-(page, head) scales through the dequant-fused Pallas
+    decode kernel, plus weight-only int8 projections. Both legs get the
+    same pool bytes; the int8 pool fits ~4x the pages, so at a page-starved
+    budget the quantized leg sustains strictly more concurrent requests
+    (and the line records tokens/s + p99 for both so the throughput side of
+    the trade is visible too)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.paged import BlockPool, PagedServingEngine
+    from paddle_tpu.models import GPTForCausalLM, gpt3_tiny, gpt3_125m
+    from paddle_tpu.observability.metrics import default_registry
+
+    interp_prev = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
+    if not on_tpu:
+        os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    try:
+        if on_tpu:
+            cfg_f, B, S, ps, n_req, max_new = gpt3_125m, 16, 512, 32, 64, 32
+            pages_budget = (B * S) // (2 * ps)  # page-starved on purpose
+        else:
+            cfg_f, B, S, ps, n_req, max_new = gpt3_tiny, 8, 96, 16, 16, 6
+            pages_budget = 13
+        cfg = cfg_f()
+        budget = pages_budget * BlockPool.page_nbytes(
+            cfg.num_layers, cfg.kv_heads, cfg.head_dim, ps)
+        workload = _serving_workload(cfg, S, n_req)
+
+        def drive(kv_quant, w8):
+            # fresh model per leg: the serve_w8 convert pass mutates in
+            # place, and the A/B must compare equal starting weights
+            paddle.seed(0)
+            model = GPTForCausalLM(cfg_f())
+            eng = PagedServingEngine(
+                model, max_batch_size=B, max_seq_len=S, page_size=ps,
+                kv_budget_bytes=budget, kv_quant=kv_quant, serve_w8=w8)
+            for prompt, temp in workload:
+                eng.add_request(prompt, max_new_tokens=max_new,
+                                temperature=temp)
+            reg = default_registry()
+            base = reg.snapshot()
+            st = _drain_serving_engine(eng, reg, metrics_path)
+            delta = reg.delta(base)
+            tokens = delta.get("serving_tokens_total{engine=paged}", 0)
+            step_lat = st["step_lat"]
+            return {
+                "tokens_per_sec": round(tokens / st["total_s"], 2),
+                "p99_token_latency_s": round(
+                    float(np.percentile(step_lat, 99)) if step_lat else 0.0,
+                    4),
+                "peak_concurrent": st["peak_live"],
+                "pages_total": eng.pool.pages_total,
+                "kv_bytes_per_token": round(eng.pool.bytes_per_token, 1),
+                "preemptions": delta.get("serving_preemptions_total", 0),
+                "quant_pages": delta.get("serving_kv_quant_pages_total", 0),
+                "compile_ticks_excluded": st["compile_ticks"],
+            }
+
+        a = drive(kv_quant=False, w8=False)
+        b = drive(kv_quant=True, w8=True)
+        peak, kind = _peak_flops(jax.devices()[0])
+        line = {
+            "metric": f"serving_quant_ab_"
+                      f"{('gpt3_125m' if on_tpu else 'gpt3_tiny')}"
+                      f"_bs{B}x{S}_{kind.replace(' ', '_')}",
+            "value": b["tokens_per_sec"],
+            "unit": "tokens_per_sec",
+            "vs_baseline": 0.0,  # reference publishes no serving number
+            "equal_kv_budget_bytes": budget,
+            "requests": n_req,
+            "dense": a,
+            "int8_kv_w8": b,
+            "concurrency_gain": (round(b["peak_concurrent"]
+                                       / a["peak_concurrent"], 2)
+                                 if a["peak_concurrent"] else 0.0),
         }
         print(json.dumps(line), flush=True)
         return line
@@ -821,7 +937,9 @@ def main():
                 ("resnet", run_resnet_rung),
                 ("unet", run_unet_rung),
                 ("moe", lambda t: run_moe_rung(t, metrics_path)),
-                ("serving", lambda t: run_serving_rung(t, metrics_path))):
+                ("serving", lambda t: run_serving_rung(t, metrics_path)),
+                ("serving_quant",
+                 lambda t: run_serving_quant_rung(t, metrics_path))):
             try:
                 results.append(rung(on_tpu))
             except Exception as e:
@@ -850,6 +968,8 @@ def main():
         run_moe_rung(on_tpu, metrics_path)
     elif cfg_name == "serving":
         run_serving_rung(on_tpu, metrics_path)
+    elif cfg_name == "serving_quant":
+        run_serving_quant_rung(on_tpu, metrics_path)
     else:
         run_gpt_rung(cfg_name, on_tpu, init_error, trace_dir)
 

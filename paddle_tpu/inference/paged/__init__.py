@@ -2,7 +2,9 @@
 
 - `BlockPool` — fixed-size physical KV pages in the layout the Pallas
   `paged_decode_attention` kernel consumes, with free-list allocation,
-  refcounted prefix sharing and copy-on-write.
+  refcounted prefix sharing and copy-on-write; `quantized=True` stores int8
+  payloads + per-(page, head) f32 scales for the dequant-fused kernel
+  (`PADDLE_TPU_KV_QUANT`).
 - `TwoQueueScheduler` — power-of-two prefill length buckets + decode/resume
   queues, admitting against a page-budget watermark.
 - `PagedServingEngine` — the continuous-batching engine over both, with

@@ -30,6 +30,18 @@ Physical page 0 is the reserved NULL page: never allocated, never referenced
 by a live block table. Parked decode rows (batch padding) route their
 per-step K/V writes there, so the fixed-shape decode program needs no
 conditional writes.
+
+Quantized layout (`quantized=True`, the `PADDLE_TPU_KV_QUANT` serving fast
+path): page payloads are int8 with one f32 dequant scale per (page, head)
+stored alongside (`scales[layer] = (k_scale, v_scale)`, each
+[n_pages, Hkv]); dequant is `payload * scale`, fused into the Pallas decode
+kernel's page load. Prefill pages quantize with abs-max per (page, head);
+decode appends keep a running abs-max per page
+(`ops.pallas.decode_attention.paged_kv_write_q8`). Prefix sharing keeps the
+SAME full-prefix blake2b keys: quantization is a deterministic function of
+page content, so two identical prefixes produce bit-identical int8 payloads
+AND scales — a shared page is interchangeable exactly as in the f32 layout,
+and COW/spill/restore move payload + scales together, bit-exactly.
 """
 
 from __future__ import annotations
@@ -45,6 +57,21 @@ from ..slo import serving_metrics
 __all__ = ["BlockPool", "prefix_page_key"]
 
 
+def _quantize_pages(x):
+    """[m, Hkv, ps, D] float pages -> (int8 payload, f32 [m, Hkv] scales):
+    symmetric abs-max per (page, head), matching paged_kv_write_q8 (±127 so
+    running-max rescales never overflow)."""
+    from ...ops.pallas.decode_attention import KV_QMAX
+
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=(2, 3))
+    scale = absmax / KV_QMAX
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x32 / safe[:, :, None, None]),
+                 -KV_QMAX, KV_QMAX).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
 def prefix_page_key(prompt: np.ndarray, page_index: int, page_size: int):
     """Sharing key for prompt page `page_index`: hash of the full token
     prefix through the page's end (clipped to the prompt length)."""
@@ -58,7 +85,7 @@ class BlockPool:
     """Fixed pool of physical KV pages shared by every layer's cache."""
 
     def __init__(self, num_layers, kv_heads, head_dim, page_size, num_pages,
-                 dtype=jnp.float32, prefix_sharing=True):
+                 dtype=jnp.float32, prefix_sharing=True, quantized=False):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
         if page_size < 1:
@@ -66,11 +93,21 @@ class BlockPool:
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         self.num_layers = int(num_layers)
+        self.kv_heads = int(kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)  # unquantized payload dtype
         self.prefix_sharing = bool(prefix_sharing)
+        self.quantized = bool(quantized)
         shape = (self.num_pages, kv_heads, self.page_size, head_dim)
+        pay_dtype = jnp.dtype(jnp.int8) if self.quantized else self.dtype
         # immutable jnp zeros: (z,)*2 aliasing is safe, .at[] copies
-        self.kv = [(jnp.zeros(shape, jnp.dtype(dtype)),) * 2
+        self.kv = [(jnp.zeros(shape, pay_dtype),) * 2
                    for _ in range(num_layers)]
+        # per-(page, head) f32 dequant scales beside the int8 payloads
+        self.scales = ([(jnp.zeros((self.num_pages, kv_heads),
+                                   jnp.float32),) * 2
+                        for _ in range(num_layers)]
+                       if self.quantized else None)
         self.free: collections.deque = collections.deque(
             range(1, self.num_pages))
         self.ref = np.zeros(self.num_pages, np.int32)
@@ -79,6 +116,31 @@ class BlockPool:
         self.allocs_total = 0  # lifetime allocations (tests/introspection)
 
     # -- accounting ------------------------------------------------------ #
+
+    @staticmethod
+    def page_nbytes(num_layers, kv_heads, head_dim, page_size,
+                    dtype=jnp.float32, quantized=False) -> int:
+        """HBM bytes one physical page costs across all layers and both K/V
+        sides — payload plus, when quantized, the per-(page, head) f32
+        scales. The unit of the equal-budget serving A/B."""
+        if quantized:
+            per_side = kv_heads * page_size * head_dim + kv_heads * 4
+        else:
+            per_side = (kv_heads * page_size * head_dim
+                        * jnp.dtype(dtype).itemsize)
+        return int(num_layers) * 2 * per_side
+
+    @property
+    def bytes_per_page(self) -> int:
+        return self.page_nbytes(self.num_layers, self.kv_heads,
+                                self.head_dim, self.page_size, self.dtype,
+                                self.quantized)
+
+    @property
+    def bytes_per_token(self) -> float:
+        """KV HBM bytes one cached token costs (all layers, K+V, amortized
+        scale overhead) — the `serving_kv_bytes_per_token` series."""
+        return self.bytes_per_page / self.page_size
 
     @property
     def pages_total(self) -> int:
@@ -92,6 +154,7 @@ class BlockPool:
         m = serving_metrics()
         m["pages_free"].set(self.pages_free)
         m["pages_total"].set(self.pages_total)
+        m["kv_bytes_per_token"].set(self.bytes_per_token)
 
     # -- allocation / refcounts ------------------------------------------ #
 
@@ -162,7 +225,8 @@ class BlockPool:
         False for shared pages (content already present — identical by key
         construction, so it is never rewritten). k_layers/v_layers: per layer
         [m, Hkv, page_size, D] page-stacked prompt K/V. One batched scatter
-        per layer per side."""
+        per layer per side. A quantized pool quantizes here (abs-max per
+        (page, head)) and scatters payload + scales together."""
         idx = [j for j, w in enumerate(write_mask) if w]
         if not idx:
             return
@@ -170,21 +234,42 @@ class BlockPool:
         sel = jnp.asarray(idx, jnp.int32)
         for li in range(self.num_layers):
             k, v = self.kv[li]
-            self.kv[li] = (k.at[tgt].set(k_layers[li][sel]),
-                           v.at[tgt].set(v_layers[li][sel]))
+            if self.quantized:
+                kq, ks = _quantize_pages(k_layers[li][sel])
+                vq, vs = _quantize_pages(v_layers[li][sel])
+                sk, sv = self.scales[li]
+                self.kv[li] = (k.at[tgt].set(kq), v.at[tgt].set(vq))
+                self.scales[li] = (sk.at[tgt].set(ks), sv.at[tgt].set(vs))
+            else:
+                self.kv[li] = (k.at[tgt].set(k_layers[li][sel]),
+                               v.at[tgt].set(v_layers[li][sel]))
+        if self.quantized:
+            serving_metrics()["kv_quant_pages"].inc(len(idx))
 
     def copy_page(self, src: int, dst: int):
         """Copy-on-write body: duplicate src's content into dst (all
-        layers). Caller owns refcount/table updates."""
+        layers; payload + scales for a quantized pool). Caller owns
+        refcount/table updates."""
         for li in range(self.num_layers):
             k, v = self.kv[li]
             self.kv[li] = (k.at[dst].set(k[src]), v.at[dst].set(v[src]))
+            if self.quantized:
+                sk, sv = self.scales[li]
+                self.scales[li] = (sk.at[dst].set(sk[src]),
+                                   sv.at[dst].set(sv[src]))
         serving_metrics()["cow_copies"].inc()
 
-    def read_pages(self, pages) -> list[tuple[np.ndarray, np.ndarray]]:
-        """Host copies of the given pages, per layer: [(k, v), ...] each
-        [m, Hkv, page_size, D] — the preemption spill buffer."""
+    def read_pages(self, pages) -> list[tuple]:
+        """Host copies of the given pages, per layer — the preemption spill
+        buffer. Unquantized: [(k, v), ...] each [m, Hkv, page_size, D];
+        quantized: [(k, v, k_scale, v_scale), ...] with [m, Hkv] scales
+        (int8 payload + f32 scales round-trip the host bit-exactly, so a
+        spilled quantized request resumes with zero extra error)."""
         idx = jnp.asarray(list(pages), jnp.int32)
+        if self.quantized:
+            return [(np.asarray(k[idx]), np.asarray(v[idx]),
+                     np.asarray(sk[idx]), np.asarray(sv[idx]))
+                    for (k, v), (sk, sv) in zip(self.kv, self.scales)]
         return [(np.asarray(k[idx]), np.asarray(v[idx]))
                 for k, v in self.kv]
 
@@ -199,6 +284,11 @@ class BlockPool:
         sel = np.asarray(list(rows), np.int32)
         for li in range(self.num_layers):
             k, v = self.kv[li]
-            k_h, v_h = kv_host[li]
+            k_h, v_h = kv_host[li][0], kv_host[li][1]
             self.kv[li] = (k.at[tgt].set(jnp.asarray(k_h[sel])),
                            v.at[tgt].set(jnp.asarray(v_h[sel])))
+            if self.quantized:
+                sk, sv = self.scales[li]
+                sk_h, sv_h = kv_host[li][2], kv_host[li][3]
+                self.scales[li] = (sk.at[tgt].set(jnp.asarray(sk_h[sel])),
+                                   sv.at[tgt].set(jnp.asarray(sv_h[sel])))

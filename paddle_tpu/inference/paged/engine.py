@@ -26,6 +26,7 @@ shared copy still exists, and are restored from host otherwise.
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -64,7 +65,13 @@ class PagedServingEngine(_ServingEngineBase):
     Same surface as the dense engine (`add_request` / `step` / `run`), plus:
     `page_size`, `num_pages` (default: the dense engine's HBM budget,
     `max_batch_size * max_seq_len` tokens worth of pages), `prefix_sharing`,
-    `watermark_pages`, and `preemption`.
+    `watermark_pages`, `preemption`, and the quantized fast path:
+    `kv_quant` (default: the `PADDLE_TPU_KV_QUANT` env toggle, captured at
+    construction — trace time for the decode program) stores int8 pages +
+    per-(page, head) f32 scales and decodes through the dequant-fused Pallas
+    kernel; `kv_budget_bytes` sizes the pool by HBM bytes instead of page
+    count (the equal-budget A/B knob — an int8 pool fits ~4x the pages of
+    an f32 one in the same budget).
     """
 
     engine_label = "paged"
@@ -72,17 +79,41 @@ class PagedServingEngine(_ServingEngineBase):
     def __init__(self, model, max_batch_size=8, max_seq_len=512, seed=0,
                  page_size=16, num_pages=None, prefix_sharing=True,
                  watermark_pages=None, preemption=True,
-                 max_prefill_buckets=None):
+                 max_prefill_buckets=None, kv_quant=None,
+                 kv_budget_bytes=None, serve_w8=None):
         super().__init__(model, max_batch_size, max_seq_len, seed,
-                         max_prefill_buckets)
+                         max_prefill_buckets, serve_w8=serve_w8)
         cfg = self.cfg
         self.ps = int(page_size)
         self.P = _pages_for_prompt(self.S, self.ps)  # block-table width
+        if kv_quant is None:
+            kv_quant = os.environ.get("PADDLE_TPU_KV_QUANT", "0") == "1"
+        self.kv_quant = bool(kv_quant)
+        if num_pages is not None and kv_budget_bytes is not None:
+            raise ValueError(
+                "pass num_pages OR kv_budget_bytes, not both — a page count "
+                "would silently override the byte budget and break the "
+                "equal-budget A/B contract")
         if num_pages is None:
-            num_pages = (self.B * self.S) // self.ps + 1  # +1: null page
+            if kv_budget_bytes is not None:
+                page_b = BlockPool.page_nbytes(
+                    cfg.num_layers, cfg.kv_heads, cfg.head_dim, self.ps,
+                    self.kv_dtype, self.kv_quant)
+                # budget covers the whole pool, reserved null page included
+                num_pages = int(kv_budget_bytes) // page_b
+                if num_pages < 2:
+                    raise ValueError(
+                        f"kv_budget_bytes={int(kv_budget_bytes)} fits "
+                        f"{num_pages} pages at {page_b} bytes/page; need >= 2 "
+                        "(the reserved null page plus one allocatable) — a "
+                        "silently enlarged pool would break the equal-budget "
+                        "A/B contract")
+            else:
+                num_pages = (self.B * self.S) // self.ps + 1  # +1: null page
         self.pool = BlockPool(cfg.num_layers, cfg.kv_heads, cfg.head_dim,
-                              self.ps, num_pages,
-                              prefix_sharing=prefix_sharing)
+                              self.ps, num_pages, dtype=self.kv_dtype,
+                              prefix_sharing=prefix_sharing,
+                              quantized=self.kv_quant)
         self.sched = TwoQueueScheduler(self.ps, watermark_pages)
         self.preemption = bool(preemption)
         self.tables = np.full((self.B, self.P), -1, np.int32)
@@ -96,7 +127,8 @@ class PagedServingEngine(_ServingEngineBase):
         # "no data")
         m = serving_metrics()
         for name in ("preemptions", "resumes", "preempted_pages",
-                     "prefix_hits", "prefix_lookups", "cow_copies"):
+                     "prefix_hits", "prefix_lookups", "cow_copies",
+                     "kv_quant_pages"):
             m[name].inc(0)
 
     # ------------------------------------------------------------------ #
@@ -309,10 +341,20 @@ class PagedServingEngine(_ServingEngineBase):
 
             self._decode_jit = jax.jit(decode, donate_argnums=(5,))
 
+        # quantized pool: each layer's cache rides as (k, v, k_scale,
+        # v_scale) so the int8 append + dequant-fused attention see payload
+        # and scales together inside the one compiled program
+        caches = ([kv + sc for kv, sc in zip(self.pool.kv, self.pool.scales)]
+                  if self.kv_quant else self.pool.kv)
         greedy_tok, logits, new_kv = self._decode_jit(
             self.params, self.buffers, jnp.asarray(self.last_tok),
-            jnp.asarray(self.lengths), jnp.asarray(self.tables), self.pool.kv)
-        self.pool.kv = [tuple(c) for c in new_kv]
+            jnp.asarray(self.lengths), jnp.asarray(self.tables), caches)
+        if self.kv_quant:
+            self.pool.kv = [tuple(c[:2]) for c in new_kv]
+            self.pool.scales = [tuple(c[2:]) for c in new_kv]
+        else:
+            self.pool.kv = [tuple(c) for c in new_kv]
+        self.last_logits = logits  # device array; tests probe divergence
         greedy_np = np.asarray(greedy_tok)
         out = {}
         for i in live:

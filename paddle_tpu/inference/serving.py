@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import time
 
 import jax
@@ -83,8 +84,22 @@ class _ServingEngineBase:
     engine_label = "base"
 
     def __init__(self, model, max_batch_size=8, max_seq_len=512, seed=0,
-                 max_prefill_buckets=None):
+                 max_prefill_buckets=None, serve_w8=None):
         model.eval()
+        # weight-only int8 serving (PADDLE_TPU_SERVE_W8, captured HERE —
+        # construction is trace time for every program this engine compiles,
+        # the PR-7/12/14 toggle rule): swap the model's Linear-family
+        # projections for QuantizedLinear before the param/buffer snapshot,
+        # so the decode/prefill programs carry int8 weights + f32 scales
+        # instead of full-precision weight HBM. In-place on `model`
+        # (idempotent) — build a fresh model per engine when A/B-ing.
+        if serve_w8 is None:
+            serve_w8 = os.environ.get("PADDLE_TPU_SERVE_W8", "0") == "1"
+        self.serve_w8 = bool(serve_w8)
+        if self.serve_w8:
+            from ..quantization import ptq_convert_for_serving
+
+            ptq_convert_for_serving(model)
         self.model = model
         self.cfg = model.config
         self.B = int(max_batch_size)
@@ -99,6 +114,15 @@ class _ServingEngineBase:
                 max_prefill_buckets += 1
         self.params = {k: p._value for k, p in model.named_parameters()}
         self.buffers = {k: b._value for k, b in model.named_buffers()}
+        # KV cache dtype flows from the model: a bf16 model gets bf16 pages
+        # instead of silently paying 2x KV bytes through a hardcoded f32
+        # default (embeddings stay full precision under serve_w8, so this
+        # reads the pre-quantization compute dtype)
+        self.kv_dtype = next(
+            (jnp.dtype(v.dtype) for v in self.params.values()
+             if jnp.issubdtype(v.dtype, jnp.floating)),
+            jnp.dtype(jnp.float32))
+        self.last_logits = None  # last decode tick's [B, vocab] device array
         self.finished: list[GenerationRequest] = []
         self._key = jax.random.PRNGKey(seed)
         self._req_seq = 0  # arrival index, keys each request's sample stream
@@ -125,7 +149,9 @@ class _ServingEngineBase:
     def _functional_forward(self, p, b, tok, pos, caches, off, tables=None):
         from ..jit import functional_call
 
-        c = [(Tensor(k_), Tensor(v_)) for k_, v_ in caches]
+        # per-layer cache entries are (k, v) — or (k, v, k_scale, v_scale)
+        # for the quantized paged layout; pass tuples through structurally
+        c = [tuple(Tensor(x) for x in layer_c) for layer_c in caches]
         kwargs = {}
         if tables is not None:
             kwargs["block_tables"] = Tensor(tables)
@@ -155,7 +181,7 @@ class _ServingEngineBase:
         pos = np.arange(Sp, dtype=np.int32)[None]
         cfg = self.cfg
         zero_c = [(jnp.zeros((1, Sp, cfg.kv_heads, cfg.head_dim),
-                             jnp.float32),) * 2
+                             self.kv_dtype),) * 2
                   for _ in range(cfg.num_layers)]
         logits, new_c = pf(self.params, self.buffers,
                            jnp.asarray(tok), jnp.asarray(pos), zero_c)
@@ -237,13 +263,13 @@ class ContinuousBatchingEngine(_ServingEngineBase):
     engine_label = "dense"
 
     def __init__(self, model, max_batch_size=8, max_seq_len=512, seed=0,
-                 max_prefill_buckets=None):
+                 max_prefill_buckets=None, serve_w8=None):
         super().__init__(model, max_batch_size, max_seq_len, seed,
-                         max_prefill_buckets)
+                         max_prefill_buckets, serve_w8=serve_w8)
         cfg = self.cfg
         self.caches = [
             (jnp.zeros((self.B, self.S, cfg.kv_heads, cfg.head_dim),
-                       jnp.float32),) * 2
+                       self.kv_dtype),) * 2
             for _ in range(cfg.num_layers)]
         self.lengths = np.zeros(self.B, np.int32)   # tokens in each slot
         self.active: list[GenerationRequest | None] = [None] * self.B
@@ -329,6 +355,7 @@ class ContinuousBatchingEngine(_ServingEngineBase):
         greedy_tok, logits, self.caches = self._decode_jit(
             self.params, self.buffers, jnp.asarray(self.last_tok), offs,
             self.caches)
+        self.last_logits = logits  # device array; tests probe divergence
         greedy_np = np.asarray(greedy_tok)
         out = {}
         for i in live:

@@ -61,6 +61,15 @@ def _build(reg):
         "pages_total": reg.gauge(
             "serving_pages_total",
             "Allocatable physical KV pages (excludes the reserved null page)"),
+        "kv_bytes_per_token": reg.gauge(
+            "serving_kv_bytes_per_token",
+            "KV-cache HBM bytes per cached token across all layers and both "
+            "K/V sides (int8 payload + amortized per-page scales when the "
+            "pool is quantized)"),
+        "kv_quant_pages": reg.counter(
+            "serving_kv_quant_pages_total",
+            "KV pages written through the int8 quantized path (prefill "
+            "scatters; decode appends requantize in place)"),
         "prefix_lookups": reg.counter(
             "serving_prefix_lookups_total",
             "Prompt-page hash lookups against the shared-prefix map"),

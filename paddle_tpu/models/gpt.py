@@ -189,14 +189,30 @@ class GPTAttention(nn.Layer):
             # into each row's next slot, then the Pallas paged kernel streams
             # exactly the live pages (scalar-prefetched block table resolves
             # the physical index in the BlockSpec index_map; no gathered
-            # cache copy is ever materialized).
-            k_all = run_op("paged_kv_update", _paged_update,
-                           [cache[0], k, block_tables, cache_offset])
-            v_all = run_op("paged_kv_update", _paged_update,
-                           [cache[1], v, block_tables, cache_offset])
-            new_cache = (k_all, v_all)
-            out = run_op("paged_decode_attention", _paged_attend,
-                         [q, k_all, v_all, block_tables, cache_offset])
+            # cache copy is ever materialized). A 4-tuple cache is the
+            # quantized layout (k, v, k_scale, v_scale): int8 payloads with
+            # per-(page, head) f32 scales — the append requantizes under a
+            # running abs-max and the kernel dequantizes in VMEM.
+            if len(cache) == 4:
+                k_all, k_sc = run_op(
+                    "paged_kv_update_q8", _paged_update_q8,
+                    [cache[0], cache[2], k, block_tables, cache_offset])
+                v_all, v_sc = run_op(
+                    "paged_kv_update_q8", _paged_update_q8,
+                    [cache[1], cache[3], v, block_tables, cache_offset])
+                new_cache = (k_all, v_all, k_sc, v_sc)
+                out = run_op(
+                    "paged_decode_attention_q8", _paged_attend_q8,
+                    [q, k_all, v_all, k_sc, v_sc, block_tables,
+                     cache_offset])
+            else:
+                k_all = run_op("paged_kv_update", _paged_update,
+                               [cache[0], k, block_tables, cache_offset])
+                v_all = run_op("paged_kv_update", _paged_update,
+                               [cache[1], v, block_tables, cache_offset])
+                new_cache = (k_all, v_all)
+                out = run_op("paged_decode_attention", _paged_attend,
+                             [q, k_all, v_all, block_tables, cache_offset])
         elif cache is not None:
             # static-capacity KV cache: cache.k/v are [B, S_max, Hkv, D]
             k_all = run_op("kv_cache_update", _dyn_update, [cache[0], k, cache_offset])
@@ -274,6 +290,28 @@ def _paged_attend(q, kc, vc, tables, lengths):
     o = paged_decode_attention(
         q.reshape(B, H, D), kc, vc, tables,
         jnp.asarray(lengths).astype(jnp.int32) + 1)
+    return o.reshape(B, S, H, D)
+
+
+def _paged_update_q8(buf, scales, new, tables, lengths):
+    """Quantized decode append: write this step's `new` [B, 1, H, D] K/V
+    rows into the int8 paged cache, growing each target page's running
+    abs-max scale when needed. Returns (cache, scales)."""
+    from ..ops.pallas.decode_attention import paged_kv_write_q8
+
+    return paged_kv_write_q8(buf, scales, new[:, 0], tables,
+                             jnp.asarray(lengths).astype(jnp.int32))
+
+
+def _paged_attend_q8(q, kc, vc, k_sc, v_sc, tables, lengths):
+    """Dequant-fused decode attention over the int8 paged cache (same
+    lengths + 1 contract as _paged_attend)."""
+    from ..ops.pallas.decode_attention import paged_decode_attention
+
+    B, S, H, D = q.shape
+    o = paged_decode_attention(
+        q.reshape(B, H, D), kc, vc, tables,
+        jnp.asarray(lengths).astype(jnp.int32) + 1, kv_scales=(k_sc, v_sc))
     return o.reshape(B, S, H, D)
 
 

@@ -29,6 +29,7 @@ __all__ = [
     "QuantizedLinear",
     "quantize_weight",
     "fake_quant",
+    "ptq_convert_for_serving",
 ]
 
 
@@ -124,6 +125,16 @@ class QuantizedLinear(nn.Layer):
         return run_op("quantized_linear", fn, ins)
 
 
+def _swap_sublayer(root, name, new_layer):
+    """Replace the sublayer at dotted path `name` under `root` — the one
+    convert-pass swap shared by PTQ.convert and ptq_convert_for_serving."""
+    parts = name.split(".")
+    parent = root
+    for p in parts[:-1]:
+        parent = getattr(parent, p)
+    setattr(parent, parts[-1], new_layer)
+
+
 class PTQ:
     """Post-training quantization driver (reference ptq.py PTQ):
     quantize() hooks an activation observer onto each target layer's
@@ -169,14 +180,48 @@ class PTQ:
                 "quantize() instrumented")
         for owner, name, sub, obs in self._observed:
             sub.forward = sub._ptq_orig_forward  # unhook the observer
-            parts = name.split(".")
-            parent = owner
-            for p in parts[:-1]:
-                parent = getattr(parent, p)
             ql = QuantizedLinear(sub, bits=bits)
             ql.activation_scale = obs.scale()
-            setattr(parent, parts[-1], ql)
+            _swap_sublayer(owner, name, ql)
         return model
+
+
+def ptq_convert_for_serving(model, bits=8):
+    """Weight-only int8 serving convert (the `PADDLE_TPU_SERVE_W8` pass):
+    swap every Linear-family projection under `model` — `nn.Linear` plus the
+    TP-sharded `ColumnParallelLinear`/`RowParallelLinear` the GPT/LLaMA
+    decoder stacks are built from — for a `QuantizedLinear` holding int8
+    weights + per-output-channel f32 scales. Embedding matrices and the LM
+    head stay full precision — the tied head shares the embedding matmul,
+    and an untied `lm_head` is skipped by name, so the contract holds for
+    both configs.
+
+    In place and idempotent: already-converted layers are skipped, so
+    calling it twice (or constructing two engines over the same model with
+    the toggle on) never double-quantizes. Weight-only is the quantization
+    that pays on TPU — activations stay in the model's compute dtype and
+    XLA folds the dequant scale into the matmul — and serving engines run
+    single-program, so the TP sharding constraints the parallel Linears
+    carry are inert there. Returns the number of layers converted."""
+    from ..distributed.fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
+
+    types = (nn.Linear, ColumnParallelLinear, RowParallelLinear)
+    n = 0
+    for name, sub in list(model.named_sublayers()):
+        if isinstance(sub, QuantizedLinear) or not isinstance(sub, types):
+            continue
+        # the output head is the projection most sensitive to weight
+        # rounding; a tied head rides the f32 embedding matmul and never
+        # reaches here, so skip the untied `lm_head` too to keep the
+        # "heads stay full precision" contract config-independent
+        if name.split(".")[-1] == "lm_head":
+            continue
+        _swap_sublayer(model, name, QuantizedLinear(sub, bits=bits))
+        n += 1
+    return n
 
 
 class QAT:

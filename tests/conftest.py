@@ -104,6 +104,19 @@ def pytest_configure(config):
                    "excluded from tier-1 (`-m 'not slow'`)")
 
 
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Tier-1 runs under a hard wall-clock budget (ROADMAP 870 s timeout);
+    print the session's total wall time so budget creep shows up in CI logs
+    as a number, not as a surprise rc=124."""
+    import time
+
+    start = getattr(terminalreporter, "_sessionstarttime", None)
+    if start is not None:
+        terminalreporter.write_sep(
+            "-", f"session wall time: {time.time() - start:.1f}s "
+                 "(tier-1 budget: 870s)")
+
+
 def pytest_collection_modifyitems(config, items):
     """PADDLE_TPU_HW=1 runs on the real chip, where the virtual 8-device CPU
     mesh is NOT configured — multi-device tests would all fail on a 1-chip

@@ -239,6 +239,11 @@ def test_all_pallas_kernels_consult_tuner(monkeypatch):
     tables = jnp.asarray([[1, 2], [3, -1]], jnp.int32)
     paged_decode_attention(qd, paged, paged, tables,
                            jnp.asarray([10, 5], jnp.int32))
+    paged_q = (paged * 16).astype(jnp.int8)
+    scales = jnp.ones((4, 2), jnp.float32) / 16
+    paged_decode_attention(qd, paged_q, paged_q, tables,
+                           jnp.asarray([10, 5], jnp.int32),
+                           kv_scales=(scales, scales))
     x = jnp.asarray(rng.standard_normal((2, 40, 96)), jnp.float32)
     rms_norm_fwd(x, None)
     layer_norm_fwd(x, None, None)
@@ -253,8 +258,9 @@ def test_all_pallas_kernels_consult_tuner(monkeypatch):
 
     tiles = autotune.chosen_tiles()
     for kernel in ("flash_fwd", "flashmask_fwd", "varlen_fwd",
-                   "decode_dense", "decode_paged", "fused_rms_norm",
-                   "fused_layer_norm", "fused_rope", "grouped_gemm"):
+                   "decode_dense", "decode_paged", "decode_paged_q8",
+                   "fused_rms_norm", "fused_layer_norm", "fused_rope",
+                   "grouped_gemm"):
         assert kernel in tiles, (kernel, sorted(tiles))
         assert tiles[kernel]["bq"] > 0 and tiles[kernel]["bk"] > 0
 
